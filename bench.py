@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Round benchmark harness (driver-run, real TPU).
+
+Serves ResNet-50 (random weights — no pretrained artifacts in the container)
+through the full production path — aiohttp HTTP -> batcher -> AOT-compiled
+XLA executable on the local TPU — drives it with the asyncio load generator,
+and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline for vs_baseline: the driver target is 12,000 img/s on v5e-8
+(BASELINE.md); this box exposes a single v5e core, so the per-chip share is
+12000/8 = 1500 img/s. vs_baseline = value / (1500 * n_local_chips).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+TARGET_V5E8_IMG_S = 12_000.0
+CHIPS_IN_TARGET = 8
+
+
+def main() -> int:
+    import jax
+
+    n_chips = max(1, len(jax.devices()))
+    per_chip_target = TARGET_V5E8_IMG_S / CHIPS_IN_TARGET * n_chips
+
+    from tpuserve.config import ModelConfig, ServerConfig
+    from tpuserve.server import ServerState, make_app
+    from tpuserve.bench.loadgen import run_load, synthetic_image_jpeg, synthetic_image_npy
+
+    cfg = ServerConfig(
+        host="127.0.0.1",
+        port=18321,
+        decode_threads=16,
+        startup_canary=False,
+        models=[
+            ModelConfig(
+                name="resnet50",
+                family="resnet50",
+                batch_buckets=[64, 128],
+                deadline_ms=50.0,
+                dtype="bfloat16",
+                parallelism="sharded",
+                request_timeout_ms=60_000.0,
+                max_inflight=2,
+                wire_size=224,  # wire bytes dominate through the dev tunnel
+            )
+        ],
+    )
+
+    t0 = time.time()
+    state = ServerState(cfg)
+    state.build()
+    print(f"# build+compile took {time.time() - t0:.1f}s", file=sys.stderr)
+
+    async def run() -> dict:
+        from aiohttp import web
+
+        app = make_app(state)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, cfg.host, cfg.port)
+        await site.start()
+        try:
+            if os.environ.get("BENCH_PAYLOAD", "jpeg") == "jpeg":
+                payload = synthetic_image_jpeg()
+                ctype = "image/jpeg"
+            else:
+                payload = synthetic_image_npy()
+                ctype = "application/x-npy"
+            print(f"# payload: {len(payload)} bytes ({ctype})", file=sys.stderr)
+            url = f"http://{cfg.host}:{cfg.port}/v1/models/resnet50:classify"
+            duration = float(os.environ.get("BENCH_DURATION", "15"))
+            concurrency = int(os.environ.get("BENCH_CONCURRENCY", "256"))
+            warmup = float(os.environ.get("BENCH_WARMUP", "5"))
+            def debug_stats() -> None:
+                if not os.environ.get("BENCH_DEBUG"):
+                    return
+                stats = state.metrics.summary()
+                for section in ("latency", "counters", "gauges"):
+                    for k, v in sorted(stats[section].items()):
+                        print(f"# {k}: {v}", file=sys.stderr)
+
+            if os.environ.get("BENCH_INPROC"):
+                result = await run_load(url, payload, ctype, duration, concurrency, warmup)
+                debug_stats()
+                return result.summary()
+            # Default: load generator in a separate process so client-side
+            # socket/JSON work doesn't share the GIL with the serving process.
+            import tempfile
+
+            with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+                f.write(payload)
+                payload_path = f.name
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "tpuserve", "bench",
+                "--url", f"http://{cfg.host}:{cfg.port}",
+                "--model", "resnet50", "--verb", "classify",
+                "--duration", str(duration), "--warmup", str(warmup),
+                "--concurrency", str(concurrency),
+                "--payload", payload_path, "--content-type", ctype,
+                stdout=asyncio.subprocess.PIPE,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+            out, _ = await proc.communicate()
+            os.unlink(payload_path)
+            debug_stats()
+            return json.loads(out.decode())
+        finally:
+            await runner.cleanup()
+
+    summary = asyncio.run(run())
+    print(f"# load result: {summary}", file=sys.stderr)
+
+    value = summary["throughput_per_s"]
+    line = {
+        "metric": "resnet50_http_throughput",
+        "value": value,
+        "unit": "img/s",
+        "vs_baseline": round(value / per_chip_target, 4),
+        "p50_ms": summary["p50_ms"],
+        "p99_ms": summary["p99_ms"],
+        "n_chips": n_chips,
+        "errors": summary["n_err"],
+    }
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

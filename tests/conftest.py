@@ -1,0 +1,47 @@
+"""Test harness setup (SURVEY.md §4).
+
+All unit tests run on CPU with 8 fake XLA devices so mesh/DP/TP logic is
+exercised without TPU hardware (the standard JAX trick; SURVEY.md §4-3).
+Environment must be set before jax imports — hence at conftest import time.
+Set TPUSERVE_TEST_TPU=1 to run the suite against the real accelerator.
+"""
+
+import os
+
+if not os.environ.get("TPUSERVE_TEST_TPU"):
+    # Force CPU even when the environment pre-sets JAX_PLATFORMS (e.g. the
+    # dev box exports JAX_PLATFORMS=axon for the tunneled TPU).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    # The dev box's sitecustomize registers the tunneled-TPU PJRT plugin and
+    # calls jax.config.update("jax_platforms", "axon,cpu"), which overrides
+    # the env var — undo it before any backend is initialized.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture
+def toy_cfg():
+    from tpuserve.config import ModelConfig
+
+    return ModelConfig(
+        name="toy",
+        family="toy",
+        batch_buckets=[1, 2, 4],
+        deadline_ms=10.0,
+        dtype="float32",
+        num_classes=10,
+        parallelism="single",
+    )

@@ -1,0 +1,135 @@
+"""Batching engine behavior (C2): flush-on-full, flush-on-deadline, padding,
+fault containment, load shedding, cancellation. SURVEY.md §4-2."""
+
+import asyncio
+import concurrent.futures as cf
+
+import numpy as np
+import pytest
+
+from tpuserve.batcher import ModelBatcher, QueueFull
+from tpuserve.config import ModelConfig
+from tpuserve.models import build
+from tpuserve.obs import Metrics
+from tpuserve.runtime import build_runtime
+
+
+@pytest.fixture(scope="module")
+def rt_model():
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                      deadline_ms=30.0, dtype="float32", num_classes=10,
+                      parallelism="single", max_queue=16)
+    model = build(cfg)
+    rt = build_runtime(model)
+    return model, rt
+
+
+def make_batcher(rt_model, **cfg_over):
+    model, rt = rt_model
+    for k, v in cfg_over.items():
+        setattr(model.cfg, k, v)
+    metrics = Metrics()
+    pool = cf.ThreadPoolExecutor(max_workers=4)
+    return ModelBatcher(model, rt, metrics, pool), metrics
+
+
+def item():
+    return np.random.default_rng(0).integers(0, 255, (8, 8, 3), dtype=np.uint8)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_flush_on_full(rt_model):
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=10_000.0)  # deadline effectively off
+        await b.start()
+        futs = [b.submit(item()) for _ in range(4)]  # == max bucket
+        res = await asyncio.wait_for(asyncio.gather(*futs), timeout=10)
+        await b.stop()
+        assert len(res) == 4
+        assert all("top_k" in r for r in res)
+        assert metrics.counter("batches_total{model=toy}").value == 1
+
+    run(go())
+
+
+def test_flush_on_deadline(rt_model):
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=25.0)
+        await b.start()
+        fut = b.submit(item())  # single request, batch can't fill
+        res = await asyncio.wait_for(fut, timeout=10)
+        await b.stop()
+        assert "top_k" in res
+        # padded to the smallest bucket (1) => fill ratio 1.0
+        assert metrics.gauge("batch_fill_ratio{model=toy}").value == 1.0
+
+    run(go())
+
+
+def test_partial_batch_padding(rt_model):
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=25.0)
+        await b.start()
+        futs = [b.submit(item()) for _ in range(3)]  # pads to bucket 4
+        res = await asyncio.wait_for(asyncio.gather(*futs), timeout=10)
+        await b.stop()
+        assert len(res) == 3
+        assert metrics.gauge("batch_fill_ratio{model=toy}").value == 0.75
+
+    run(go())
+
+
+def test_fault_containment(rt_model):
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=20.0)
+        await b.start()
+        boom = {"on": True}
+
+        def hook():
+            if boom["on"]:
+                raise RuntimeError("injected fault")
+
+        b.fault_hook = hook
+        fut = b.submit(item())
+        with pytest.raises(RuntimeError, match="injected fault"):
+            await asyncio.wait_for(fut, timeout=10)
+        assert metrics.counter("batch_errors_total{model=toy}").value == 1
+        # server keeps serving after the failed batch
+        boom["on"] = False
+        res = await asyncio.wait_for(b.submit(item()), timeout=10)
+        assert "top_k" in res
+        await b.stop()
+
+    run(go())
+
+
+def test_load_shedding(rt_model):
+    async def go():
+        b, _ = make_batcher(rt_model, max_queue=2, deadline_ms=10_000.0)
+        # don't start the group loops: nothing drains the queue
+        await b.start()
+        b._queues[None] = asyncio.Queue()  # pre-create so no task spawns
+        b.submit(item())
+        b.submit(item())
+        with pytest.raises(QueueFull):
+            b.submit(item())
+        await b.stop()
+
+    run(go())
+
+
+def test_cancelled_requests_skipped(rt_model):
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=40.0, max_queue=16)
+        await b.start()
+        f1 = b.submit(item())
+        f2 = b.submit(item())
+        f1.cancel()
+        res = await asyncio.wait_for(f2, timeout=10)
+        assert "top_k" in res
+        await b.stop()
+
+    run(go())

@@ -1,0 +1,81 @@
+"""Config loading, overrides, validation (C9)."""
+
+import pytest
+
+from tpuserve.config import ModelConfig, ServerConfig, default_config, load_config
+
+
+def test_default_config():
+    cfg = default_config()
+    assert cfg.port == 8000
+    assert cfg.models[0].family == "resnet50"
+
+
+def test_load_toml(tmp_path):
+    p = tmp_path / "serve.toml"
+    p.write_text(
+        """
+port = 9001
+decode_threads = 4
+
+[[model]]
+name = "rn"
+family = "resnet50"
+batch_buckets = [1, 8]
+deadline_ms = 2.5
+
+[[model]]
+name = "bert"
+family = "bert"
+seq_buckets = [64, 128]
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.port == 9001
+    assert cfg.decode_threads == 4
+    assert len(cfg.models) == 2
+    assert cfg.model("rn").batch_buckets == [1, 8]
+    assert cfg.model("rn").deadline_ms == 2.5
+    assert cfg.model("bert").seq_buckets == [64, 128]
+
+
+def test_overrides(tmp_path):
+    p = tmp_path / "serve.toml"
+    p.write_text('port = 9001\n[[model]]\nname = "rn"\nfamily = "resnet50"\n')
+    cfg = load_config(str(p), overrides=["port=7000", "model.rn.deadline_ms=1.5",
+                                         "model.rn.batch_buckets=[2, 4]"])
+    assert cfg.port == 7000
+    assert cfg.model("rn").deadline_ms == 1.5
+    assert cfg.model("rn").batch_buckets == [2, 4]
+
+
+def test_options_dict_override(tmp_path):
+    p = tmp_path / "serve.toml"
+    p.write_text('[[model]]\nname = "sd"\nfamily = "sd15"\n')
+    cfg = load_config(str(p), overrides=["model.sd.options.num_steps=4"])
+    assert cfg.model("sd").options["num_steps"] == 4
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "bad.toml"
+    p.write_text("bogus_key = 1\n")
+    with pytest.raises(ValueError, match="unknown"):
+        load_config(str(p))
+
+
+def test_unknown_override_field():
+    cfg = ServerConfig(models=[ModelConfig(name="m")])
+    with pytest.raises(ValueError, match="unknown config field"):
+        load_config_overrides(cfg, "model.m.nope=1")
+
+
+def load_config_overrides(cfg, ov):
+    from tpuserve.config import _apply_override
+
+    _apply_override(cfg, ov)
+
+
+def test_model_lookup_missing():
+    cfg = ServerConfig()
+    with pytest.raises(KeyError):
+        cfg.model("nope")

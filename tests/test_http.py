@@ -1,0 +1,158 @@
+"""HTTP integration (C1): real aiohttp app, toy model, full request path.
+SURVEY.md §4-5: responses, error paths, /metrics, /healthz, /stats, trace.
+
+No pytest-asyncio in the image: one module-level event loop drives a real
+TestServer/TestClient pair, and each test runs coroutines on it explicitly.
+"""
+
+import asyncio
+import io
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpuserve.config import ModelConfig, ServerConfig
+from tpuserve.server import ServerState, make_app
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def client(loop):
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single", request_timeout_ms=10_000.0)],
+        decode_threads=2,
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def setup():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    client = loop.run_until_complete(setup())
+    yield lambda coro: loop.run_until_complete(coro), client
+    loop.run_until_complete(client.close())
+
+
+def npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def toy_image() -> bytes:
+    return npy_bytes(np.random.default_rng(0).integers(0, 255, (8, 8, 3), dtype=np.uint8))
+
+
+def test_predict_roundtrip(client):
+    run, c = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=toy_image(),
+                            headers={"Content-Type": "application/x-npy"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert len(body["top_k"]) == 3
+        assert all(0 <= e["class"] < 10 for e in body["top_k"])
+
+    run(go())
+
+
+def test_classify_alias(client):
+    run, c = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:classify", data=toy_image(),
+                            headers={"Content-Type": "application/x-npy"})
+        assert resp.status == 200
+
+    run(go())
+
+
+def test_jpeg_body(client):
+    from PIL import Image
+
+    run, c = client
+    buf = io.BytesIO()
+    Image.new("RGB", (32, 32), (120, 30, 200)).save(buf, format="JPEG")
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=buf.getvalue(),
+                            headers={"Content-Type": "image/jpeg"})
+        assert resp.status == 200
+
+    run(go())
+
+
+def test_unknown_model_404(client):
+    run, c = client
+
+    async def go():
+        resp = await c.post("/v1/models/nope:predict", data=b"x")
+        assert resp.status == 404
+
+    run(go())
+
+
+def test_bad_payload_400(client):
+    run, c = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=b"this is not an image",
+                            headers={"Content-Type": "image/jpeg"})
+        assert resp.status == 400
+
+    run(go())
+
+
+def test_health_metrics_stats_trace(client):
+    run, c = client
+
+    async def go():
+        await c.post("/v1/models/toy:predict", data=toy_image(),
+                     headers={"Content-Type": "application/x-npy"})
+
+        resp = await c.get("/healthz")
+        assert resp.status == 200
+        assert (await resp.json())["status"] == "ok"
+
+        resp = await c.get("/metrics")
+        text = await resp.text()
+        assert "requests_total" in text
+        assert "latency_ms_bucket" in text
+
+        resp = await c.get("/stats")
+        stats = await resp.json()
+        assert stats["counters"]["requests_total{model=toy}"] >= 1
+
+        resp = await c.get("/v1/models")
+        models = await resp.json()
+        assert models["toy"]["buckets"] == [[1], [2]]
+
+        resp = await c.get("/debug/trace")
+        assert resp.status == 200
+        assert "traceEvents" in await resp.text()
+
+    run(go())
+
+
+def test_index_page(client):
+    run, c = client
+
+    async def go():
+        resp = await c.get("/")
+        assert resp.status == 200
+        assert "tpuserve" in await resp.text()
+
+    run(go())

@@ -1,0 +1,57 @@
+"""Metrics/histograms/tracer (C8)."""
+
+import json
+
+from tpuserve.obs import Histogram, Metrics, percentile
+
+
+def test_histogram_quantiles():
+    h = Histogram("lat")
+    for v in [1.0] * 90 + [100.0] * 10:
+        h.observe(v)
+    assert h.n == 100
+    assert h.quantile(0.5) <= 2.0
+    assert h.quantile(0.99) >= 50.0
+
+
+def test_metrics_prometheus_render():
+    m = Metrics()
+    m.counter("requests_total{model=rn}").inc(3)
+    m.gauge("queue_depth{model=rn}").set(7)
+    m.observe_phase("rn", "total", 12.5)
+    text = m.render_prometheus()
+    assert 'requests_total{model="rn"} 3' in text  # label values quoted
+    assert 'queue_depth{model="rn"} 7' in text
+    assert "# TYPE latency_ms histogram" in text
+    assert 'model="rn"' in text and 'phase="total"' in text
+    # one TYPE line per metric base name even with multiple label sets
+    m.counter("requests_total{model=other}").inc()
+    text = m.render_prometheus()
+    assert text.count("# TYPE requests_total counter") == 1
+
+
+def test_metrics_summary():
+    m = Metrics()
+    m.observe_phase("rn", "total", 10.0)
+    m.observe_phase("rn", "total", 20.0)
+    s = m.summary()
+    key = "latency_ms{model=rn,phase=total}"
+    assert s["latency"][key]["n"] == 2
+    assert 10 <= s["latency"][key]["mean_ms"] <= 20
+
+
+def test_tracer_chrome_format():
+    m = Metrics()
+    m.tracer.add("compute", 100.0, 100.010, tid="rn", batch=8)
+    data = json.loads(m.tracer.chrome_trace())
+    (ev,) = data["traceEvents"]
+    assert ev["name"] == "compute"
+    assert ev["ph"] == "X"
+    assert abs(ev["dur"] - 10_000) < 1
+    assert ev["args"]["batch"] == 8
+
+
+def test_percentile_exact():
+    assert percentile([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 0.5) == 5
+    assert percentile([], 0.5) == 0.0
+    assert percentile([42], 0.99) == 42

@@ -1,0 +1,91 @@
+"""Runtime AOT compilation and execution (C5) on fake CPU devices."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpuserve.config import ModelConfig
+from tpuserve.models import build
+from tpuserve.runtime import build_runtime
+
+
+@pytest.fixture(scope="module")
+def toy_runtime():
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                      dtype="float32", num_classes=10, parallelism="single")
+    model = build(cfg)
+    return model, build_runtime(model)
+
+
+def test_compiles_all_buckets(toy_runtime):
+    _, rt = toy_runtime
+    assert sorted(rt.executables) == [(1,), (2,), (4,)]
+
+
+def test_sharded_buckets_mesh_aligned():
+    """Sharded mode rounds buckets up to data-axis multiples (8 fake devs)."""
+    cfg = ModelConfig(name="toys", family="toy", batch_buckets=[1, 2, 4, 16],
+                      dtype="float32", num_classes=10, parallelism="sharded")
+    rt = build_runtime(build(cfg))
+    assert sorted(rt.executables) == [(8,), (16,)]
+
+
+def test_run_and_fetch(toy_runtime):
+    model, rt = toy_runtime
+    batch = np.random.default_rng(0).integers(0, 255, size=(4, 8, 8, 3), dtype=np.uint8)
+    out = rt.fetch(rt.run((4,), batch))
+    assert out["probs"].shape == (4, 3)
+    assert out["indices"].shape == (4, 3)
+    np.testing.assert_allclose(out["probs"].sum(axis=-1) <= 1.0, True)
+
+
+def test_deterministic(toy_runtime):
+    model, rt = toy_runtime
+    batch = np.full((2, 8, 8, 3), 17, dtype=np.uint8)
+    a = rt.fetch(rt.run((2,), batch))
+    b = rt.fetch(rt.run((2,), batch))
+    np.testing.assert_array_equal(a["indices"], b["indices"])
+    np.testing.assert_allclose(a["probs"], b["probs"], rtol=1e-6)
+
+
+def test_sharded_batch_across_mesh():
+    """Batch dim sharded over the data axis of the 8-device mesh runs + matches."""
+    cfg = ModelConfig(name="toy8", family="toy", batch_buckets=[8],
+                      dtype="float32", num_classes=10, parallelism="sharded")
+    model = build(cfg)
+    rt8 = build_runtime(model)
+    assert rt8.meshes[0].shape["data"] == 8
+    batch = np.random.default_rng(2).integers(0, 255, (8, 8, 8, 3), dtype=np.uint8)
+    out = rt8.fetch(rt8.run((8,), batch.copy()))
+    assert out["probs"].shape == (8, 3)
+
+    # sharded result == single-device result on identical params/batch
+    cfg1 = ModelConfig(name="toy1", family="toy", batch_buckets=[8],
+                       dtype="float32", num_classes=10, parallelism="single")
+    rt1 = build_runtime(build(cfg1))
+    out1 = rt1.fetch(rt1.run((8,), batch.copy()))
+    np.testing.assert_allclose(out["probs"], out1["probs"], rtol=1e-5)
+    np.testing.assert_array_equal(out["indices"], out1["indices"])
+
+
+def test_replica_mode():
+    cfg = ModelConfig(name="toyr", family="toy", batch_buckets=[1],
+                      dtype="float32", num_classes=10, parallelism="replica")
+    rt = build_runtime(build(cfg))
+    assert len(rt.meshes) == len(jax.devices())
+    batch = np.zeros((1, 8, 8, 3), dtype=np.uint8)
+    outs = [rt.fetch(rt.run((1,), batch)) for _ in range(3)]
+    for o in outs[1:]:
+        np.testing.assert_allclose(o["probs"], outs[0]["probs"], rtol=1e-6)
+
+
+def test_padding_lanes_do_not_affect_real_lanes(toy_runtime):
+    """Core static-shape invariant (SURVEY.md §4-1)."""
+    model, rt = toy_runtime
+    item = np.random.default_rng(1).integers(0, 255, size=(8, 8, 3), dtype=np.uint8)
+    solo = model.assemble([item], (1,))
+    padded = model.assemble([item], (4,))
+    out1 = rt.fetch(rt.run((1,), solo))
+    out4 = rt.fetch(rt.run((4,), padded))
+    np.testing.assert_allclose(out1["probs"][0], out4["probs"][0], rtol=1e-5)
+    np.testing.assert_array_equal(out1["indices"][0], out4["indices"][0])

@@ -1,0 +1,90 @@
+"""Weight import/export (C6): orbax round-trip, TF SavedModel/GraphDef
+extraction, format detection."""
+
+import numpy as np
+import pytest
+
+from tpuserve import savedmodel
+from tpuserve.config import ModelConfig
+from tpuserve.models import build
+
+
+@pytest.fixture()
+def toy_model():
+    return build(ModelConfig(name="toy", family="toy", dtype="float32", num_classes=10))
+
+
+def test_orbax_roundtrip(tmp_path, toy_model):
+    import jax
+
+    params = toy_model.init_params(jax.random.key(0))
+    path = str(tmp_path / "ckpt")
+    savedmodel.save_orbax(path, params)
+    assert savedmodel.detect_format(path) == "orbax"
+
+    restored = savedmodel.load_orbax(path, toy_model)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, restored,
+    )
+
+
+def test_load_params_via_weights_config(tmp_path, toy_model):
+    import jax
+
+    params = toy_model.init_params(jax.random.key(0))
+    path = str(tmp_path / "ckpt")
+    savedmodel.save_orbax(path, params)
+
+    cfg = ModelConfig(name="toy2", family="toy", dtype="float32", num_classes=10,
+                      weights=path)
+    m2 = build(cfg)
+    loaded = m2.load_params()
+    np.testing.assert_array_equal(np.asarray(loaded["w1"]), np.asarray(params["w1"]))
+
+
+def test_saved_model_extraction(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    class M(tf.Module):
+        def __init__(self):
+            super().__init__()
+            self.w = tf.Variable(np.arange(6, dtype=np.float32).reshape(2, 3), name="dense/kernel")
+            self.b = tf.Variable(np.zeros(3, np.float32), name="dense/bias")
+
+        @tf.function(input_signature=[tf.TensorSpec([None, 2], tf.float32)])
+        def __call__(self, x):
+            return x @ self.w + self.b
+
+    path = str(tmp_path / "sm")
+    tf.saved_model.save(M(), path)
+    assert savedmodel.detect_format(path) == "saved_model"
+    flat = savedmodel.extract_saved_model_variables(path)
+    # keys are object-graph attribute paths ("w", "b")
+    assert "w" in flat and "b" in flat, sorted(flat)
+    np.testing.assert_array_equal(flat["w"], np.arange(6, dtype=np.float32).reshape(2, 3))
+
+
+def test_graphdef_extraction(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+
+    gd = tf.compat.v1.GraphDef()
+    with tf.Graph().as_default() as g:
+        tf.constant(np.ones((2, 2), np.float32), name="layer/const_w")
+        gd = g.as_graph_def()
+    path = str(tmp_path / "frozen.pb")
+    with open(path, "wb") as f:
+        f.write(gd.SerializeToString())
+    assert savedmodel.detect_format(path) == "graphdef"
+    flat = savedmodel.extract_graphdef_constants(path)
+    np.testing.assert_array_equal(flat["layer/const_w"], np.ones((2, 2)))
+
+
+def test_unknown_format(tmp_path):
+    with pytest.raises(ValueError):
+        savedmodel.detect_format(str(tmp_path / "nope.bin"))
+
+
+def test_import_tf_variables_default_raises(toy_model):
+    with pytest.raises(NotImplementedError, match="orbax"):
+        toy_model.import_tf_variables({"w": np.zeros(2)})

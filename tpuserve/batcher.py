@@ -1,0 +1,199 @@
+"""Static-shape request batching engine (SURVEY.md §2 C2, §3c).
+
+The reference accumulates requests into dynamic batches; XLA wants static
+shapes, so this batcher assembles **padded, bucketed** batches:
+
+- Requests are routed to a *group* (model-defined: e.g. seq-len bucket for
+  text; vision models have one group). Each group has its own accumulation
+  task and queue.
+- A group flushes when the largest batch bucket fills, or when the oldest
+  request has waited ``deadline_ms`` (flush-on-deadline), whichever is first.
+- The flush picks the smallest configured batch bucket >= the ready count and
+  zero-pads up to it; ``host_postprocess`` only reads the valid rows, and
+  padded lanes are tested to never perturb real lanes
+  (tests/test_runtime.py::test_padding_lanes_do_not_affect_real_lanes).
+- Dispatch is pipelined: up to ``max_inflight`` batches are in flight on the
+  device at once (assembly, H2D and the blocking D2H fetch run in a
+  threadpool; the event loop never blocks), hiding H2D under compute.
+
+Failure containment (SURVEY.md §5): an executable failure fails only that
+batch's futures; the group task and server keep serving. Client disconnects
+cancel futures, which are dropped at flush time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures as cf
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Hashable
+
+from tpuserve.models.base import ServingModel
+from tpuserve.obs import Metrics
+from tpuserve.runtime import ModelRuntime
+
+log = logging.getLogger("tpuserve.batcher")
+
+
+class QueueFull(Exception):
+    """Raised by submit() when the model queue is at capacity (-> HTTP 429)."""
+
+
+@dataclass
+class _Request:
+    item: Any  # decoded input (np arrays), model-specific
+    group: Hashable
+    future: asyncio.Future = field(repr=False)
+    enqueued_at: float = 0.0  # time.perf_counter()
+
+
+class ModelBatcher:
+    """One batching engine per served model."""
+
+    def __init__(
+        self,
+        model: ServingModel,
+        runtime: ModelRuntime,
+        metrics: Metrics,
+        pool: cf.ThreadPoolExecutor,
+    ) -> None:
+        self.model = model
+        self.runtime = runtime
+        self.metrics = metrics
+        self.pool = pool
+        self.cfg = model.cfg
+        self._queues: dict[Hashable, asyncio.Queue[_Request]] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._dispatch_tasks: set[asyncio.Task] = set()
+        self._inflight: asyncio.Semaphore | None = None
+        self._pending = 0
+        self._running = False
+        # test-only fault injection hook: callable raised inside dispatch
+        self.fault_hook = None
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start(self) -> None:
+        self._running = True
+        self._inflight = asyncio.Semaphore(max(1, self.cfg.max_inflight))
+
+    async def stop(self) -> None:
+        """Cancel accumulation, then drain in-flight batches to completion."""
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks.clear()
+        self._queues.clear()
+        if self._dispatch_tasks:
+            await asyncio.gather(*self._dispatch_tasks, return_exceptions=True)
+
+    # -- submission (event loop) --------------------------------------------
+    def submit(self, item: Any, group: Hashable = None) -> asyncio.Future:
+        """Enqueue one decoded request; returns a Future of its result."""
+        if self._pending >= self.cfg.max_queue:
+            self.metrics.counter(f"shed_total{{model={self.model.name}}}").inc()
+            raise QueueFull(self.model.name)
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        req = _Request(item=item, group=group, future=fut, enqueued_at=time.perf_counter())
+        q = self._queues.get(group)
+        if q is None:
+            q = self._queues[group] = asyncio.Queue()
+            self._tasks.append(loop.create_task(self._group_loop(group, q)))
+        q.put_nowait(req)
+        self._pending += 1
+        self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
+        return fut
+
+    # -- accumulation (event loop) ------------------------------------------
+    async def _group_loop(self, group: Hashable, q: asyncio.Queue) -> None:
+        max_bucket = max(self.cfg.batch_buckets)
+        deadline_s = self.cfg.deadline_ms / 1e3
+        while True:
+            req = await q.get()
+            batch = [req]
+            flush_at = req.enqueued_at + deadline_s
+            while len(batch) < max_bucket:
+                timeout = flush_at - time.perf_counter()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(q.get(), timeout))
+                except asyncio.TimeoutError:
+                    break
+            # Backpressure: the semaphore bounds in-flight device batches; the
+            # group task itself waits here, which is what pipelines dispatch.
+            await self._inflight.acquire()
+            # Adaptive drain: anything that queued while we waited (deadline or
+            # a free slot) would only wait longer — fold it into this batch up
+            # to the largest bucket. This makes batch size track device speed
+            # instead of deadline x arrival-rate (SURVEY.md §7 hard-part 2).
+            while len(batch) < max_bucket and not q.empty():
+                batch.append(q.get_nowait())
+            self._pending -= len(batch)
+            self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
+            live = [r for r in batch if not r.future.cancelled()]
+            if not live:
+                self._inflight.release()
+                continue
+            now = time.perf_counter()
+            for r in live:
+                self.metrics.observe_phase(self.model.name, "queue", (now - r.enqueued_at) * 1e3)
+            task = asyncio.get_running_loop().create_task(self._dispatch(live, group))
+            self._dispatch_tasks.add(task)
+            task.add_done_callback(self._dispatch_tasks.discard)
+
+    # -- dispatch (threadpool does the blocking work) ------------------------
+    async def _dispatch(self, reqs: list[_Request], group: Hashable) -> None:
+        loop = asyncio.get_running_loop()
+        name = self.model.name
+        try:
+            bucket = self.model.bucket_for(len(reqs), group=group)
+            fill = len(reqs) / bucket[0]
+            self.metrics.gauge(f"batch_fill_ratio{{model={name}}}").set(fill)
+            self.metrics.counter(f"batches_total{{model={name}}}").inc()
+
+            t0 = time.perf_counter()
+            items = [r.item for r in reqs]
+            host_batch = await loop.run_in_executor(
+                self.pool, self.model.assemble, items, bucket
+            )
+            t1 = time.perf_counter()
+            self.metrics.observe_phase(name, "preproc", (t1 - t0) * 1e3)
+
+            if self.fault_hook is not None:
+                self.fault_hook()
+
+            outputs = await loop.run_in_executor(self.pool, self.runtime.run, bucket, host_batch)
+            t2 = time.perf_counter()
+            self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+
+            np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
+            t3 = time.perf_counter()
+            self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+
+            results = self.model.host_postprocess(np_out, len(reqs))
+            t4 = time.perf_counter()
+            self.metrics.observe_phase(name, "postproc", (t4 - t3) * 1e3)
+            self.metrics.counter(f"items_total{{model={name}}}").inc(len(reqs))
+            self.metrics.tracer.add(
+                f"batch[{bucket}]", time.time() - (t4 - t0), time.time(),
+                tid=name, n=len(reqs), fill=fill,
+            )
+            for r, res in zip(reqs, results):
+                if not r.future.done():
+                    r.future.set_result(res)
+        except Exception as e:  # contain: fail only this batch
+            log.exception("batch dispatch failed for %s", name)
+            self.metrics.counter(f"batch_errors_total{{model={name}}}").inc()
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+        finally:
+            self._inflight.release()

@@ -1,0 +1,1 @@
+"""Benchmark harness (SURVEY.md §2 C11): load generator + baselines."""

@@ -1,0 +1,127 @@
+"""Asyncio HTTP load generator (SURVEY.md §2 C11).
+
+Closed-loop with fixed concurrency: C workers each keep exactly one request
+in flight, recording per-request latency. Reports throughput (items/s), p50,
+p99 — the BASELINE.md metrics. Used by ``python -m tpuserve bench`` and by
+the repo-root ``bench.py`` harness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import json
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from tpuserve.obs import percentile
+
+
+@dataclass
+class LoadResult:
+    n_ok: int = 0
+    n_err: int = 0
+    duration_s: float = 0.0
+    latencies_ms: list[float] = field(default_factory=list)
+
+    @property
+    def throughput(self) -> float:
+        return self.n_ok / self.duration_s if self.duration_s > 0 else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "n_ok": self.n_ok,
+            "n_err": self.n_err,
+            "duration_s": round(self.duration_s, 3),
+            "throughput_per_s": round(self.throughput, 1),
+            "p50_ms": round(percentile(self.latencies_ms, 0.5), 3),
+            "p99_ms": round(percentile(self.latencies_ms, 0.99), 3),
+        }
+
+
+def synthetic_image_npy(edge: int = 256, seed: int = 0) -> bytes:
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 255, (edge, edge, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def synthetic_image_jpeg(edge: int = 256, seed: int = 0, quality: int = 85) -> bytes:
+    """A realistic photo-like JPEG (smooth gradients compress like photos)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    y, x = np.mgrid[0:edge, 0:edge].astype(np.float32) / edge
+    base = np.stack([
+        0.5 + 0.5 * np.sin(6.28 * (x + rng.random())),
+        0.5 + 0.5 * np.cos(6.28 * (y + rng.random())),
+        0.5 + 0.5 * np.sin(6.28 * (x * y + rng.random())),
+    ], axis=-1)
+    noise = rng.normal(0, 0.05, base.shape)
+    arr = np.clip((base + noise) * 255, 0, 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.getvalue()
+
+
+async def run_load(
+    url: str,
+    payload: bytes,
+    content_type: str,
+    duration_s: float = 10.0,
+    concurrency: int = 64,
+    warmup_s: float = 2.0,
+) -> LoadResult:
+    import aiohttp
+
+    result = LoadResult()
+    stop_at = 0.0
+    record_from = 0.0
+
+    async def worker(session: aiohttp.ClientSession) -> None:
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            try:
+                async with session.post(
+                    url, data=payload, headers={"Content-Type": content_type}
+                ) as resp:
+                    await resp.read()
+                    ok = resp.status == 200
+            except Exception:
+                ok = False
+            t1 = time.perf_counter()
+            if t1 < record_from:
+                continue
+            if ok:
+                result.n_ok += 1
+                result.latencies_ms.append((t1 - t0) * 1e3)
+            else:
+                result.n_err += 1
+
+    conn = aiohttp.TCPConnector(limit=concurrency * 2)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        now = time.perf_counter()
+        record_from = now + warmup_s
+        stop_at = now + warmup_s + duration_s
+        workers = [asyncio.ensure_future(worker(session)) for _ in range(concurrency)]
+        await asyncio.gather(*workers)
+    result.duration_s = duration_s
+    return result
+
+
+def run_loadgen_cli(args) -> int:
+    if args.payload:
+        with open(args.payload, "rb") as f:
+            payload = f.read()
+    else:
+        payload = synthetic_image_npy()
+    url = f"{args.url}/v1/models/{args.model}:{args.verb}"
+    result = asyncio.run(
+        run_load(url, payload, args.content_type, args.duration, args.concurrency,
+                 warmup_s=getattr(args, "warmup", 2.0))
+    )
+    print(json.dumps(result.summary()))
+    return 0 if result.n_ok > 0 else 1

@@ -1,0 +1,107 @@
+"""Command-line entry points (SURVEY.md §2 C10).
+
+Usage::
+
+    python -m tpuserve serve  --config serve.toml [--set port=9000 ...]
+    python -m tpuserve bench  --url http://127.0.0.1:8000 --model resnet50 ...
+    python -m tpuserve import-model --saved-model DIR --family resnet50 --out CKPT
+    python -m tpuserve warmup --config serve.toml   (compile + persist XLA cache)
+    python -m tpuserve describe                      (device/mesh inventory)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _add_config_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", default=None, help="TOML config path")
+    p.add_argument("--set", dest="overrides", action="append", default=[],
+                   help="dot-path override, e.g. --set model.resnet50.deadline_ms=2")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpuserve")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_serve = sub.add_parser("serve", help="start the inference server")
+    _add_config_args(p_serve)
+
+    p_bench = sub.add_parser("bench", help="run the HTTP load generator")
+    p_bench.add_argument("--url", default="http://127.0.0.1:8000")
+    p_bench.add_argument("--model", default="resnet50")
+    p_bench.add_argument("--verb", default="predict")
+    p_bench.add_argument("--duration", type=float, default=10.0)
+    p_bench.add_argument("--warmup", type=float, default=2.0)
+    p_bench.add_argument("--concurrency", type=int, default=64)
+    p_bench.add_argument("--payload", default=None, help="file to POST; default synthetic image")
+    p_bench.add_argument("--content-type", default="application/x-npy")
+
+    p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
+    p_imp.add_argument("--saved-model", required=True)
+    p_imp.add_argument("--family", required=True)
+    p_imp.add_argument("--out", required=True)
+
+    p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
+    _add_config_args(p_warm)
+
+    sub.add_parser("describe", help="print device / mesh inventory")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "serve":
+        from tpuserve.config import default_config, load_config
+        from tpuserve.server import serve
+
+        if args.config:
+            cfg = load_config(args.config, args.overrides)
+        else:
+            cfg = default_config()
+            for ov in args.overrides:
+                from tpuserve.config import _apply_override
+
+                _apply_override(cfg, ov)
+        serve(cfg)
+        return 0
+
+    if args.cmd == "bench":
+        from tpuserve.bench.loadgen import run_loadgen_cli
+
+        return run_loadgen_cli(args)
+
+    if args.cmd == "import-model":
+        from tpuserve import savedmodel
+
+        savedmodel.convert_cli(args.saved_model, args.family, args.out)
+        return 0
+
+    if args.cmd == "warmup":
+        from tpuserve.config import default_config, load_config
+        from tpuserve.server import ServerState
+
+        cfg = load_config(args.config, args.overrides) if args.config else default_config()
+        state = ServerState(cfg)
+        state.build()
+        print(json.dumps({n: rt.describe() for n, rt in state.runtimes.items()}, indent=2))
+        return 0
+
+    if args.cmd == "describe":
+        import jax
+
+        from tpuserve.parallel import make_mesh
+
+        mesh = make_mesh()
+        print(json.dumps({
+            "devices": [str(d) for d in jax.devices()],
+            "platform": jax.devices()[0].platform,
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+        }, indent=2))
+        return 0
+
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""Typed configuration for tpuserve (SURVEY.md §2 C9).
+
+The reference's configuration story is unknowable (empty mount, SURVEY.md §0);
+per SURVEY.md §5 the build uses typed dataclasses, an optional TOML file, and
+CLI dot-path overrides — no global mutable flag framework.
+
+Example TOML::
+
+    port = 8000
+
+    [[model]]
+    name = "resnet50"
+    family = "resnet50"
+    batch_buckets = [1, 4, 8, 16, 32]
+    deadline_ms = 5.0
+    dtype = "bfloat16"
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ModelConfig:
+    """Per-model serving configuration."""
+
+    name: str
+    # Which implementation in tpuserve.models to build.
+    family: str = "resnet50"
+    # Optional path to weights: a TF SavedModel dir, a frozen GraphDef .pb,
+    # or an orbax checkpoint dir. None => seeded random init (no-network dev).
+    weights: str | None = None
+    # Static batch-size buckets, ascending. Each (bucket, input-shape) pair is
+    # AOT-compiled to its own XLA executable at startup.
+    batch_buckets: list[int] = field(default_factory=lambda: [1, 4, 8, 16, 32])
+    # Sequence-length buckets for text models (BERT, SD text encoder).
+    seq_buckets: list[int] = field(default_factory=lambda: [64, 128, 256, 512])
+    # Batcher flush deadline: a request waits at most this long for the batch
+    # to fill before a partial (padded) batch is dispatched.
+    deadline_ms: float = 5.0
+    # Max requests queued before the server sheds load with 429s.
+    max_queue: int = 4096
+    # Per-request end-to-end deadline -> 504 when exceeded.
+    request_timeout_ms: float = 2000.0
+    # Compute dtype for params/activations on device.
+    dtype: str = "bfloat16"
+    # Image input edge (H == W) for vision models.
+    image_size: int = 224
+    # Host->device wire shape edge for images: host decodes to (wire, wire, 3)
+    # uint8; the device resizes to image_size. Smaller wire = fewer PCIe (or
+    # dev-tunnel) bytes; 256 leaves headroom for crop-style augmentation.
+    wire_size: int = 256
+    # Parallelism mode: "sharded" (one executable, batch sharded over the
+    # mesh), "replica" (one executable per device, independent queues), or
+    # "single" (first device only). SURVEY.md §2.1.
+    parallelism: str = "sharded"
+    # Tensor-parallel axis size carved out of the mesh (1 = TP off).
+    tp: int = 1
+    # Model-specific knobs (e.g. SD: num_steps, guidance_scale; detect: score
+    # threshold). Kept open-ended on purpose.
+    options: dict[str, Any] = field(default_factory=dict)
+    # Number of classes / detection size etc. where the family needs it.
+    num_classes: int = 1000
+    # Number of in-flight device batches the dispatcher pipelines (>=1).
+    max_inflight: int = 2
+
+
+@dataclass
+class ServerConfig:
+    """Top-level server configuration."""
+
+    host: str = "0.0.0.0"
+    port: int = 8000
+    models: list[ModelConfig] = field(default_factory=list)
+    # Host-side decode threadpool size.
+    decode_threads: int = 8
+    # jax.profiler.start_server port; 0 disables.
+    profiler_port: int = 0
+    # Directory for the persistent XLA compilation cache ("" disables).
+    compilation_cache_dir: str = ""
+    # Validate-on-startup canary (tiny inference per model) on/off.
+    startup_canary: bool = True
+    # Observability: max request-trace events kept for /debug/trace.
+    trace_capacity: int = 65536
+
+    def model(self, name: str) -> ModelConfig:
+        for m in self.models:
+            if m.name == name:
+                return m
+        raise KeyError(f"no model named {name!r} configured")
+
+
+def _build(cls: type, data: dict[str, Any]) -> Any:
+    """Construct dataclass ``cls`` from a dict, erroring on unknown keys."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - names
+    if unknown:
+        raise ValueError(f"unknown {cls.__name__} keys: {sorted(unknown)}")
+    return cls(**data)
+
+
+def load_config(path: str | None = None, overrides: list[str] | None = None) -> ServerConfig:
+    """Load a ServerConfig from a TOML file plus ``key.path=value`` overrides.
+
+    Overrides use dot paths, e.g. ``port=9000`` or
+    ``model.resnet50.deadline_ms=2.5`` (the second path element selects the
+    model by name). Values are parsed as TOML scalars/arrays.
+    """
+    raw: dict[str, Any] = {}
+    if path:
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+
+    model_dicts = raw.pop("model", [])
+    cfg: ServerConfig = _build(ServerConfig, raw)
+    cfg.models = [_build(ModelConfig, m) for m in model_dicts]
+
+    for ov in overrides or []:
+        _apply_override(cfg, ov)
+    return cfg
+
+
+def _parse_toml_value(text: str) -> Any:
+    try:
+        return tomllib.loads(f"v = {text}")["v"]
+    except tomllib.TOMLDecodeError:
+        return text  # bare string
+
+
+def _apply_override(cfg: ServerConfig, override: str) -> None:
+    if "=" not in override:
+        raise ValueError(f"override must look like key.path=value, got {override!r}")
+    key, _, text = override.partition("=")
+    value = _parse_toml_value(text.strip())
+    parts = key.strip().split(".")
+
+    target: Any = cfg
+    if parts[0] == "model":
+        if len(parts) < 3:
+            raise ValueError(f"model override needs model.<name>.<field>: {override!r}")
+        target = cfg.model(parts[1])
+        parts = parts[2:]
+    for p in parts[:-1]:
+        target = target[p] if isinstance(target, dict) else getattr(target, p)
+    leaf = parts[-1]
+    if isinstance(target, dict):  # e.g. model.<name>.options.<key>
+        target[leaf] = value
+        return
+    if dataclasses.is_dataclass(target) and leaf not in {f.name for f in dataclasses.fields(target)}:
+        raise ValueError(f"unknown config field {leaf!r} in {type(target).__name__}")
+    setattr(target, leaf, value)
+
+
+def default_config() -> ServerConfig:
+    """The out-of-the-box config: ResNet-50 with random weights."""
+    return ServerConfig(models=[ModelConfig(name="resnet50", family="resnet50")])

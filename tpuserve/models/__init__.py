@@ -1,0 +1,51 @@
+"""Model zoo (SURVEY.md §2 C4): the five benchmark families.
+
+Each family implements the ``ServingModel`` interface in ``base.py``:
+a jittable on-device ``forward`` (with fused resize/normalize preproc and
+on-device postproc like top-k / NMS), host-side request decode, and
+regex partition rules for tensor parallelism.
+
+Families (BASELINE.json ``configs``):
+- resnet50       — ResNet-50 ImageNet classify
+- mobilenetv3    — MobileNetV3-Large, batch=1 latency mode
+- bert           — BERT-base text classification, bucketed seq lens
+- efficientdet   — EfficientDet-D0 detection with fixed-shape NMS
+- sd15           — Stable Diffusion 1.5 txt2img, fori_loop denoise
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from tpuserve.config import ModelConfig
+    from tpuserve.models.base import ServingModel
+
+_REGISTRY: dict[str, str] = {
+    "resnet50": "tpuserve.models.resnet",
+    "mobilenetv3": "tpuserve.models.mobilenet",
+    "bert": "tpuserve.models.bert",
+    "efficientdet": "tpuserve.models.efficientdet",
+    "sd15": "tpuserve.models.sd15",
+    "toy": "tpuserve.models.toy",
+}
+
+
+def build(cfg: "ModelConfig") -> "ServingModel":
+    """Instantiate the ServingModel for cfg.family."""
+    import importlib
+
+    if cfg.family not in _REGISTRY:
+        raise KeyError(f"unknown model family {cfg.family!r}; known: {sorted(_REGISTRY)}")
+    try:
+        mod = importlib.import_module(_REGISTRY[cfg.family])
+    except ModuleNotFoundError as e:
+        raise NotImplementedError(
+            f"model family {cfg.family!r} is registered but its module "
+            f"{_REGISTRY[cfg.family]} is not implemented yet"
+        ) from e
+    return mod.create(cfg)
+
+
+def families() -> list[str]:
+    return sorted(_REGISTRY)

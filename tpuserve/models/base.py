@@ -1,0 +1,135 @@
+"""ServingModel: the contract between the model zoo and the runtime/batcher.
+
+Design (SURVEY.md §3b/§3c): the runtime AOT-compiles ``forward`` once per
+(batch-bucket, input-shape) pair at startup; the batcher assembles padded
+host batches, and ``forward`` does everything device-side — resize/normalize
+preprocessing fused in front of the network, and postprocessing (top-k, NMS,
+image decode to uint8) fused behind it — so exactly two host<->device
+crossings happen per batch (H2D inputs, D2H small outputs).
+
+``forward`` must be a pure jittable function of (params, batch) with static
+shapes. Dynamic request counts are handled by padding: the batcher passes
+``n_valid`` alongside the batch, and host_postprocess slices the first
+``n_valid`` rows. Padded lanes must not influence real lanes (tested in
+tests/test_runtime.py::test_padding_lanes_do_not_affect_real_lanes).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpuserve.config import ModelConfig
+
+# A host batch: pytree of np.ndarrays with leading batch dim.
+HostBatch = Any
+# Device outputs: pytree of jax.Arrays with leading batch dim.
+Outputs = Any
+
+
+class ServingModel(abc.ABC):
+    """One deployable model family instance."""
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        self.cfg = cfg
+        self.name = cfg.name
+
+    # -- parameters ---------------------------------------------------------
+    @abc.abstractmethod
+    def init_params(self, rng: jax.Array) -> Any:
+        """Seeded random params (no-network dev mode, SURVEY.md §7 hard pt 8)."""
+
+    def load_params(self) -> Any:
+        """Load real weights if cfg.weights is set, else random init."""
+        if self.cfg.weights:
+            from tpuserve import savedmodel
+
+            return savedmodel.load_params_for(self)
+        return self.init_params(jax.random.key(0))
+
+    def import_tf_variables(self, flat: dict[str, np.ndarray]) -> Any:
+        """Translate a flat TF {name: array} dict into this model's pytree.
+
+        Family-specific (name schemes and layouts differ per source repo);
+        implement when wiring real TF weights for the family.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no TF variable mapping; convert the "
+            "weights to an orbax checkpoint or implement import_tf_variables"
+        )
+
+    # -- shapes -------------------------------------------------------------
+    @abc.abstractmethod
+    def input_signature(self, bucket: tuple) -> Any:
+        """Pytree of jax.ShapeDtypeStruct for a bucket key.
+
+        Bucket keys are model-specific tuples: ``(batch,)`` for vision,
+        ``(batch, seq)`` for text.
+        """
+
+    def buckets(self) -> list[tuple]:
+        """All bucket keys to AOT-compile at startup."""
+        return [(b,) for b in self.cfg.batch_buckets]
+
+    def bucket_for(self, n: int, **kw) -> tuple:
+        """Smallest bucket that fits n requests (used by the batcher)."""
+        for b in self.cfg.batch_buckets:
+            if b >= n:
+                return (b,)
+        return (self.cfg.batch_buckets[-1],)
+
+    # -- device-side --------------------------------------------------------
+    @abc.abstractmethod
+    def forward(self, params: Any, batch: HostBatch) -> Outputs:
+        """Jittable: on-device preproc + network + on-device postproc."""
+
+    # -- host-side ----------------------------------------------------------
+    @abc.abstractmethod
+    def host_decode(self, payload: bytes, content_type: str) -> Any:
+        """Decode one request body into per-item input arrays (threadpool).
+
+        Runs in the decode threadpool; must touch only its own arguments.
+        """
+
+    def canary_item(self) -> Any:
+        """A trivial decoded item used by health canaries; default zero image."""
+        w = self.cfg.wire_size
+        return np.zeros((w, w, 3), dtype=np.uint8)
+
+    def group_key(self, item: Any) -> Any:
+        """Batching group for a decoded item (e.g. seq bucket); None = one group."""
+        return None
+
+    @abc.abstractmethod
+    def host_postprocess(self, outputs: Outputs, n_valid: int) -> list[Any]:
+        """Convert device outputs (already np) to n_valid JSON-able results."""
+
+    def assemble(self, items: list[Any], bucket: tuple) -> HostBatch:
+        """Stack decoded items into one padded host batch for `bucket`.
+
+        Default: items are single np arrays; stack along axis 0 and pad the
+        batch dim with zeros up to bucket[0].
+        """
+        b = bucket[0]
+        arr = np.stack(items, axis=0)
+        if arr.shape[0] < b:
+            pad = np.zeros((b - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
+            arr = np.concatenate([arr, pad], axis=0)
+        return arr
+
+    # -- parallelism --------------------------------------------------------
+    def partition_rules(self) -> list[tuple[str, P]]:
+        """Ordered (regex, PartitionSpec) rules for params; default replicate."""
+        return [(".*", P())]
+
+    def batch_spec(self) -> Any:
+        """PartitionSpec pytree for the batch input (leading dim = data axis)."""
+        return P("data")
+
+    def out_spec(self) -> Any:
+        """PartitionSpec pytree for forward outputs."""
+        return P("data")

@@ -1,0 +1,136 @@
+"""ResNet-50 ImageNet classifier (SURVEY.md §2 C4; BASELINE.json config 1).
+
+TPU-first shaping decisions:
+- NHWC layout end-to-end (XLA:TPU's native conv layout; the MXU sees large
+  bf16 convs with no transposes).
+- On-device fused preprocessing: uint8 (B,256,256,3) crosses PCIe; bilinear
+  resize to 224 + normalize happen in front of conv1 inside the executable
+  (tpuserve.preproc.device_prepare_images).
+- On-device postprocessing: softmax + top-k (lax.top_k) so only (B,5) indices
+  and probabilities cross back to the host.
+- BatchNorm folded to inference mode (use_running_average=True); batch_stats
+  live in the param pytree like any other weights.
+
+Architecture: standard ResNet-v1.5 bottleneck [3,4,6,3] (He et al. 2015,
+torchvision convention: stride-2 on the 3x3 of downsampling bottlenecks).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve import preproc
+from tpuserve.config import ModelConfig
+from tpuserve.models.base import ServingModel
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int = 1
+    projection: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=True, momentum=0.9,
+                     epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(bn(name="bn1")(y))
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides), name="conv2")(y)
+        y = nn.relu(bn(name="bn2")(y))
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = bn(name="bn3")(y)
+        if self.projection:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.strides, self.strides), name="proj_conv")(x)
+            residual = bn(name="proj_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=True, momentum=0.9, epsilon=1e-5,
+                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            features = 64 * 2**i
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(features, strides=strides, projection=(j == 0),
+                               dtype=self.dtype, name=f"stage{i + 1}_block{j + 1}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+class ResNet50Serving(ServingModel):
+    TOP_K = 5
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        super().__init__(cfg)
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.module = ResNet(num_classes=cfg.num_classes, dtype=self.dtype)
+
+    def init_params(self, rng: jax.Array) -> Any:
+        dummy = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), self.dtype)
+        return self.module.init(rng, dummy)
+
+    def input_signature(self, bucket: tuple) -> Any:
+        (b,) = bucket
+        w = self.cfg.wire_size
+        return jax.ShapeDtypeStruct((b, w, w, 3), jnp.uint8)
+
+    def forward(self, params: Any, batch: jax.Array) -> dict:
+        x = preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
+        logits = self.module.apply(params, x)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_p, top_i = jax.lax.top_k(probs, self.TOP_K)
+        return {"probs": top_p, "indices": top_i}
+
+    def host_decode(self, payload: bytes, content_type: str) -> np.ndarray:
+        return preproc.decode_image(payload, content_type, edge=self.cfg.wire_size)
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
+        probs = outputs["probs"][:n_valid]
+        idx = outputs["indices"][:n_valid]
+        return [
+            {
+                "top_k": [
+                    {"class": int(i), "prob": float(p)}
+                    for i, p in zip(idx[r], probs[r])
+                ]
+            }
+            for r in range(n_valid)
+        ]
+
+    def partition_rules(self):
+        """TP rules (off unless cfg.tp > 1): shard wide convs/dense on 'model'."""
+        from jax.sharding import PartitionSpec as P
+
+        if self.cfg.tp <= 1:
+            return [(".*", P())]
+        return [
+            (r"head/kernel", P(None, "model")),
+            (r"conv\d?/kernel", P(None, None, None, "model")),
+            (r".*", P()),
+        ]
+
+
+def create(cfg: ModelConfig) -> ResNet50Serving:
+    return ResNet50Serving(cfg)

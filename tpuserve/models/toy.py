@@ -1,0 +1,73 @@
+"""Toy model family: a tiny MLP classifier used by the test suite.
+
+Fast to compile on CPU, exercises the full ServingModel contract (on-device
+preproc, top-k postproc, padding semantics) without real-model compile times.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve.config import ModelConfig
+from tpuserve.models.base import ServingModel
+
+EDGE = 8  # toy wire shape: (8, 8, 3) uint8
+
+
+class ToyServing(ServingModel):
+    TOP_K = 3
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        super().__init__(cfg)
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.hidden = int(cfg.options.get("hidden", 32))
+
+    def init_params(self, rng: jax.Array) -> Any:
+        k1, k2 = jax.random.split(rng)
+        d_in = EDGE * EDGE * 3
+        return {
+            "w1": jax.random.normal(k1, (d_in, self.hidden), jnp.float32) * 0.02,
+            "b1": jnp.zeros((self.hidden,), jnp.float32),
+            "w2": jax.random.normal(k2, (self.hidden, self.cfg.num_classes), jnp.float32) * 0.02,
+            "b2": jnp.zeros((self.cfg.num_classes,), jnp.float32),
+        }
+
+    def input_signature(self, bucket: tuple) -> Any:
+        (b,) = bucket
+        return jax.ShapeDtypeStruct((b, EDGE, EDGE, 3), jnp.uint8)
+
+    def forward(self, params: Any, batch: jax.Array) -> dict:
+        x = batch.astype(self.dtype).reshape(batch.shape[0], -1) / 255.0
+        h = jnp.tanh(x @ params["w1"].astype(self.dtype) + params["b1"].astype(self.dtype))
+        logits = h @ params["w2"].astype(self.dtype) + params["b2"].astype(self.dtype)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        k = min(self.TOP_K, self.cfg.num_classes)
+        top_p, top_i = jax.lax.top_k(probs, k)
+        return {"probs": top_p, "indices": top_i}
+
+    def host_decode(self, payload: bytes, content_type: str) -> np.ndarray:
+        from tpuserve import preproc
+
+        return preproc.decode_image(payload, content_type, edge=EDGE)
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
+        return [
+            {
+                "top_k": [
+                    {"class": int(i), "prob": float(p)}
+                    for i, p in zip(outputs["indices"][r], outputs["probs"][r])
+                ]
+            }
+            for r in range(n_valid)
+        ]
+
+    def canary_item(self) -> np.ndarray:
+        return np.zeros((EDGE, EDGE, 3), dtype=np.uint8)
+
+
+def create(cfg: ModelConfig) -> ToyServing:
+    return ToyServing(cfg)

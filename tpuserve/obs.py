@@ -1,0 +1,276 @@
+"""Observability: metrics, phase timing, request tracing (SURVEY.md §2 C8, §5).
+
+The reference's observability is unknowable (empty mount); BASELINE.json's
+``metric`` field defines what must be observable: throughput (img/s) and
+p50/p99 latency. The build records:
+
+- counters (requests, errors, images served),
+- fixed-bucket latency histograms split by phase
+  (queue / preproc / h2d / compute / total),
+- gauges (queue depth, batch fill ratio, in-flight batches),
+- a bounded ring buffer of request-scoped span events, dumpable as
+  Chrome ``chrome://tracing`` JSON.
+
+Everything is in-process and designed for a single asyncio event loop plus a
+decode threadpool: histogram/counter updates take a short lock (contention is
+negligible at the update rates involved; the scrape path merges under the same
+lock).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Iterable
+
+
+def _default_latency_buckets() -> list[float]:
+    # Exponential 0.1ms .. ~104s, 21 buckets. Milliseconds.
+    return [0.1 * (2.0**i) for i in range(21)]
+
+
+class Histogram:
+    """Fixed-bucket histogram (milliseconds by default)."""
+
+    def __init__(self, name: str, buckets: list[float] | None = None) -> None:
+        self.name = name
+        self.bounds = buckets or _default_latency_buckets()
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0.0
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):  # noqa: B007
+            if value <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self.counts[i] += 1
+            self.total += value
+            self.n += 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket boundaries (upper bound)."""
+        with self._lock:
+            n = self.n
+            if n == 0:
+                return 0.0
+            rank = math.ceil(q * n)
+            acc = 0
+            for i, c in enumerate(self.counts):
+                acc += c
+                if acc >= rank:
+                    return self.bounds[i] if i < len(self.bounds) else float("inf")
+        return float("inf")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"n": self.n, "total": self.total, "counts": list(self.counts)}
+
+
+class Counter:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Gauge:
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+
+@dataclass
+class SpanEvent:
+    """One completed span: request-scoped phase timing."""
+
+    name: str
+    ts_us: float  # start, microseconds since epoch
+    dur_us: float
+    tid: str = "main"  # logical track: model name or "http"
+    args: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Bounded ring buffer of spans; dumps Chrome trace JSON."""
+
+    def __init__(self, capacity: int = 65536) -> None:
+        self._events: deque[SpanEvent] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def add(self, name: str, start_s: float, end_s: float, tid: str = "main", **args) -> None:
+        ev = SpanEvent(name, start_s * 1e6, (end_s - start_s) * 1e6, tid, args)
+        with self._lock:
+            self._events.append(ev)
+
+    def chrome_trace(self) -> str:
+        with self._lock:
+            events = list(self._events)
+        out = [
+            {
+                "name": e.name,
+                "ph": "X",
+                "ts": e.ts_us,
+                "dur": e.dur_us,
+                "pid": 0,
+                "tid": e.tid,
+                "args": e.args,
+            }
+            for e in events
+        ]
+        return json.dumps({"traceEvents": out})
+
+
+PHASES = ("queue", "preproc", "h2d", "compute", "postproc", "total")
+
+
+class Metrics:
+    """Registry of all server metrics. One instance per server process."""
+
+    def __init__(self, trace_capacity: int = 65536) -> None:
+        self._lock = threading.Lock()
+        self._histograms: dict[str, Histogram] = {}
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self.tracer = Tracer(trace_capacity)
+        self.started_at = time.time()
+
+    # -- registry -----------------------------------------------------------
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            return h
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    # -- convenience --------------------------------------------------------
+    def observe_phase(self, model: str, phase: str, ms: float) -> None:
+        self.histogram(f"latency_ms{{model={model},phase={phase}}}").observe(ms)
+
+    # -- export -------------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._histograms.values())
+        typed: set[str] = set()
+
+        def emit(name: str, kind: str, value: float) -> None:
+            base, labels = _split(name)
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} {kind}")
+            label_str = "{" + labels.rstrip(",") + "}" if labels else ""
+            lines.append(f"{base}{label_str} {value}")
+
+        for c in counters:
+            emit(c.name, "counter", c.value)
+        for g in gauges:
+            emit(g.name, "gauge", g.value)
+        for h in hists:
+            base, labels = _split(h.name)
+            if base not in typed:
+                typed.add(base)
+                lines.append(f"# TYPE {base} histogram")
+            snap = h.snapshot()
+            acc = 0
+            for bound, count in zip(h.bounds, snap["counts"]):
+                acc += count
+                lines.append(f'{base}_bucket{{{labels}le="{bound:g}"}} {acc}')
+            lines.append(f'{base}_bucket{{{labels}le="+Inf"}} {snap["n"]}')
+            lines.append(f"{base}_sum{{{labels.rstrip(',')}}} {snap['total']}")
+            lines.append(f"{base}_count{{{labels.rstrip(',')}}} {snap['n']}")
+        return "\n".join(lines) + "\n"
+
+    def summary(self) -> dict:
+        """JSON-friendly summary used by /stats and the bench harness."""
+        out: dict = {"uptime_s": time.time() - self.started_at, "counters": {}, "gauges": {}, "latency": {}}
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._histograms)
+        for name, c in counters.items():
+            out["counters"][name] = c.value
+        for name, g in gauges.items():
+            out["gauges"][name] = g.value
+        for name, h in hists.items():
+            out["latency"][name] = {
+                "n": h.n,
+                "mean_ms": (h.total / h.n) if h.n else 0.0,
+                "p50_ms": h.quantile(0.5),
+                "p99_ms": h.quantile(0.99),
+            }
+        return out
+
+
+def _split(name: str) -> tuple[str, str]:
+    """'lat{model=x,phase=y}' -> ('lat', 'model="x",phase="y",')."""
+    if "{" not in name:
+        return name, ""
+    base, _, rest = name.partition("{")
+    rest = rest.rstrip("}")
+    pairs = [p.split("=", 1) for p in rest.split(",") if p]
+    labels = ",".join(f'{k}="{v}"' for k, v in pairs)
+    return base, labels + "," if labels else ""
+
+
+class phase_timer:
+    """Context manager: time a phase into Metrics (+ optional trace span)."""
+
+    def __init__(self, metrics: Metrics, model: str, phase: str, trace: bool = False) -> None:
+        self.metrics = metrics
+        self.model = model
+        self.phase = phase
+        self.trace = trace
+
+    def __enter__(self) -> "phase_timer":
+        self.t0 = time.perf_counter()
+        self.wall0 = time.time()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        t1 = time.perf_counter()
+        ms = (t1 - self.t0) * 1e3
+        self.metrics.observe_phase(self.model, self.phase, ms)
+        if self.trace:
+            self.metrics.tracer.add(self.phase, self.wall0, self.wall0 + (t1 - self.t0), tid=self.model)
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    """Exact percentile of a finite sample (bench-side helper)."""
+    vs = sorted(values)
+    if not vs:
+        return 0.0
+    idx = min(len(vs) - 1, max(0, math.ceil(q * len(vs)) - 1))
+    return vs[idx]

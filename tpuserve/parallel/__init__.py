@@ -1,0 +1,28 @@
+"""Parallelism layer (SURVEY.md §2 C7, §2.1).
+
+TPU-native parallelism is expressed through ``jax.sharding``: a ``Mesh`` over
+the device grid, ``NamedSharding``/``PartitionSpec`` annotations on inputs,
+params, and outputs, and XLA-inserted collectives riding ICI. There is no
+user-managed NCCL/MPI backend to configure — the communication backend IS the
+sharding layout (SURVEY.md §5 "Distributed communication backend").
+
+Submodules:
+
+- ``mesh``       — mesh construction (dp/tp axes, multi-host seam)
+- ``partition``  — regex partition rules -> PartitionSpec pytrees
+- ``ring``       — ring attention / sequence parallelism (ops-level impl in
+                   tpuserve.ops.ring_attention; this module wires meshes)
+"""
+
+from tpuserve.parallel.mesh import (  # noqa: F401
+    MeshPlan,
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    local_device_count,
+)
+from tpuserve.parallel.partition import (  # noqa: F401
+    match_partition_rules,
+    named_leaves,
+    shard_pytree,
+)

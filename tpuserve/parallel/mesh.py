@@ -1,0 +1,78 @@
+"""Mesh construction over TPU devices (SURVEY.md §2.1).
+
+Axis conventions used throughout tpuserve:
+
+- ``"data"``  — data parallel: batches sharded across it, params replicated.
+- ``"model"`` — tensor parallel: weight matrices sharded across it.
+- ``"seq"``   — sequence/context parallel (ring attention) for long inputs.
+
+An inference mesh is usually ``("data",)`` or ``("data", "model")``; the
+training step used by the multi-chip dry run adds ``"seq"``. The same code
+path handles 1 local core (dev box), 8 cores (v5e-8), and — via
+``jax.distributed`` — multi-host slices: the mesh is always built from
+``jax.devices()``, never hard-coded counts (SURVEY.md §7 hard part 7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """How to carve the device grid into named axes."""
+
+    dp: int = -1  # -1 = "everything not claimed by other axes"
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        tp, sp = self.tp, self.sp
+        if n_devices % (tp * sp) != 0:
+            raise ValueError(f"{n_devices} devices not divisible by tp*sp={tp * sp}")
+        dp = self.dp if self.dp != -1 else n_devices // (tp * sp)
+        if dp * tp * sp != n_devices:
+            raise ValueError(f"dp*tp*sp={dp * tp * sp} != device count {n_devices}")
+        return dp, tp, sp
+
+
+def make_mesh(plan: MeshPlan | None = None, devices: list | None = None) -> Mesh:
+    """Build a Mesh with axes (data, model[, seq]).
+
+    Axes of size 1 for model/seq are still materialized so PartitionSpecs
+    mentioning them remain valid regardless of configuration; XLA treats a
+    size-1 axis as free.
+    """
+    plan = plan or MeshPlan()
+    devices = devices if devices is not None else jax.devices()
+    dp, tp, sp = plan.resolve(len(devices))
+    grid = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs/outputs: shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Params (DP mode): fully replicated."""
+    return NamedSharding(mesh, P())
+
+
+def pad_batch_to_mesh(batch_size: int, mesh: Mesh) -> int:
+    """Smallest batch >= batch_size divisible by the data-axis size."""
+    d = mesh.shape[DATA_AXIS]
+    return ((batch_size + d - 1) // d) * d

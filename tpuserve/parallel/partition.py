@@ -1,0 +1,81 @@
+"""Regex partition rules -> PartitionSpec pytrees (SURVEY.md §2 C7).
+
+Pattern: a model family publishes an ordered list of ``(regex, PartitionSpec)``
+rules; each param leaf's '/'-joined path is matched against the rules in order
+and the first hit wins. This is the standard public-JAX idiom for assigning
+shardings to large param trees (cf. SNIPPETS.md snippet [3], pattern only) and
+replaces per-layer hand annotation.
+
+Scalars and size-1 leaves are never partitioned. A final catch-all rule
+(e.g. ``(".*", P())``) is recommended; without one, unmatched leaves raise.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _join_path(path, sep: str) -> str:
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return sep.join(parts)
+
+
+def named_leaves(tree: Any, sep: str = "/") -> list[tuple[str, Any]]:
+    """Flatten a pytree into (path, leaf) pairs with readable '/' paths."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_join_path(path, sep), leaf) for path, leaf in flat]
+
+
+def tree_map_with_name(fn: Callable[[str, Any], Any], tree: Any, sep: str = "/") -> Any:
+    """tree_map where fn also receives the '/'-joined path of each leaf."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    mapped = [fn(_join_path(path, sep), leaf) for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, mapped)
+
+
+def match_partition_rules(rules: list[tuple[str, P]], params: Any) -> Any:
+    """Return a pytree of PartitionSpec following ordered regex rules."""
+
+    def spec_for(name: str, leaf: Any) -> P:
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matched param {name!r}")
+
+    return tree_map_with_name(spec_for, params)
+
+
+def specs_to_shardings(specs: Any, mesh: Mesh) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_pytree(params: Any, rules: list[tuple[str, P]], mesh: Mesh) -> Any:
+    """Device-put every leaf with its rule-derived NamedSharding."""
+    specs = match_partition_rules(rules, params)
+    shardings = specs_to_shardings(specs, mesh)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+# A catch-all: replicate everything (correct default for DP inference).
+REPLICATED_RULES: list[tuple[str, P]] = [(".*", P())]

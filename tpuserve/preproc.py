@@ -1,0 +1,91 @@
+"""Input preprocessing (SURVEY.md §2 C3).
+
+Split deliberately across the host/device boundary (BASELINE.json north-star:
+"Image decode/resize preprocessing moves on-device so the host only handles
+HTTP and JSON"):
+
+- Host (threadpool): byte decode only — JPEG/PNG -> uint8 RGB (Pillow; an
+  optional C++ libjpeg-turbo shim slots in behind the same function, SURVEY.md
+  C12), raw tensor parsing, JSON parsing. No resize, no float math.
+- Device (inside the jitted forward): resize to model resolution, dtype cast,
+  normalize — fused by XLA into the first conv's pipeline, so uint8 images
+  cross PCIe (3x smaller than f32) and HBM sees bf16.
+
+Host decode emits a fixed "wire shape" (DECODE_EDGE^2 uint8) so one XLA
+executable serves arbitrary client image sizes: Pillow does a cheap
+nearest-ish downscale to the wire shape only when the client image is larger;
+the precise bilinear resize to the model's input size happens on device.
+"""
+
+from __future__ import annotations
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Wire shape edge for images: host sends (E, E, 3) uint8; device resizes to
+# the model size. 256 covers 224/240/260-class models with margin for crops.
+DECODE_EDGE = 256
+
+# ImageNet normalization constants (standard publication values).
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+# -- host side ---------------------------------------------------------------
+
+def decode_image(payload: bytes, content_type: str = "", edge: int = DECODE_EDGE) -> np.ndarray:
+    """Bytes -> (edge, edge, 3) uint8 RGB. Runs in the decode threadpool.
+
+    Accepts JPEG/PNG/etc via Pillow, or a raw npy tensor
+    (content_type == "application/x-npy") of shape (H, W, 3) uint8.
+    """
+    if content_type == "application/x-npy":
+        arr = np.load(io.BytesIO(payload), allow_pickle=False)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            raise ValueError(f"raw tensor must be (H, W, 3), got {arr.shape}")
+        if arr.dtype != np.uint8:
+            raise ValueError(f"raw tensor must be uint8 (0-255), got {arr.dtype}")
+        img = arr
+        if img.shape[:2] != (edge, edge):
+            img = _resize_uint8(img, edge)
+        return img
+    from PIL import Image
+
+    with Image.open(io.BytesIO(payload)) as im:
+        im = im.convert("RGB")
+        if im.size != (edge, edge):
+            im = im.resize((edge, edge), Image.BILINEAR)
+        return np.asarray(im, dtype=np.uint8)
+
+
+def _resize_uint8(img: np.ndarray, edge: int) -> np.ndarray:
+    from PIL import Image
+
+    return np.asarray(Image.fromarray(img).resize((edge, edge), Image.BILINEAR), dtype=np.uint8)
+
+
+# -- device side (call inside jitted forward) --------------------------------
+
+def device_prepare_images(
+    batch_u8: jax.Array,
+    size: int,
+    dtype=jnp.bfloat16,
+    mean=IMAGENET_MEAN,
+    std=IMAGENET_STD,
+) -> jax.Array:
+    """(B, E, E, 3) uint8 -> (B, size, size, 3) normalized `dtype`.
+
+    Resize (bilinear) + scale + normalize, all on device; XLA fuses the
+    elementwise tail into the consumer conv.
+    """
+    x = batch_u8.astype(jnp.float32) / 255.0
+    if batch_u8.shape[1] != size or batch_u8.shape[2] != size:
+        b, _, _, c = batch_u8.shape
+        x = jax.image.resize(x, (b, size, size, c), method="bilinear")
+    mean_a = jnp.asarray(mean, dtype=jnp.float32)
+    std_a = jnp.asarray(std, dtype=jnp.float32)
+    x = (x - mean_a) / std_a
+    return x.astype(dtype)

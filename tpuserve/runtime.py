@@ -1,0 +1,228 @@
+"""Runtime: AOT compile & execute model executables on the mesh (SURVEY.md C5).
+
+The reference runs TF SavedModel graphs on TensorFlow-GPU; the TPU-native
+equivalent compiles each (model, bucket) pair once, ahead of time, to an XLA
+executable resident on the device mesh:
+
+    jax.jit(forward, in_shardings=..., out_shardings=..., donate_argnums=(1,))
+        .lower(params_struct, batch_struct).compile()
+
+Static shapes are the contract: every batch bucket (and seq bucket for text)
+is its own executable, compiled at startup — in parallel across buckets — and
+cached persistently via the JAX compilation cache so restart != recompile
+(SURVEY.md §5 checkpoint/resume).
+
+Execution is asynchronous: ``run`` dispatches and returns device arrays
+immediately (XLA async dispatch); ``fetch`` blocks for D2H and is intended to
+be called off the event loop (batcher runs it in a threadpool) so batch N+1
+dispatches while N computes — the dispatch pipelining from SURVEY.md §7
+hard-part 2.
+
+Parallelism modes per model (SURVEY.md §2.1):
+- "sharded": one executable over the whole mesh; batch sharded on the data
+  axis; params replicated or TP-sharded by the model's partition rules.
+- "replica": one single-device executable per device, independent queues —
+  lower p50 for batch=1 latency models (MobileNetV3).
+- "single": first device only (dev mode).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuserve.config import ModelConfig, ServerConfig
+from tpuserve.models.base import ServingModel
+from tpuserve.parallel import make_mesh, match_partition_rules
+from tpuserve.parallel.mesh import MeshPlan
+from tpuserve.parallel.partition import specs_to_shardings
+
+log = logging.getLogger("tpuserve.runtime")
+
+
+def configure_jax(cfg: ServerConfig) -> None:
+    """Process-wide JAX settings (call once, before any compilation)."""
+    if cfg.compilation_cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+@dataclass
+class Executable:
+    """One compiled (bucket, device-set) executable."""
+
+    bucket: tuple
+    compiled: Any  # jax.stages.Compiled
+    batch_sharding: Any  # pytree of NamedSharding for the batch input
+    device_index: int = 0  # replica mode: which replica
+
+
+class ModelRuntime:
+    """Owns params-on-device and the compiled executable set for one model."""
+
+    def __init__(self, model: ServingModel, mesh: Mesh | None = None) -> None:
+        self.model = model
+        self.cfg: ModelConfig = model.cfg
+        self.mode = self.cfg.parallelism
+        if self.mode not in ("sharded", "replica", "single"):
+            raise ValueError(f"unknown parallelism mode {self.mode!r}")
+
+        if self.mode == "replica":
+            # One 1-device mesh per device; params replicated per device.
+            self.meshes = [make_mesh(MeshPlan(), devices=[d]) for d in jax.devices()]
+        elif self.mode == "single":
+            self.meshes = [make_mesh(MeshPlan(), devices=[jax.devices()[0]])]
+        else:
+            self.meshes = [mesh if mesh is not None else make_mesh(MeshPlan(tp=self.cfg.tp))]
+
+        if self.mode == "sharded":
+            # Sharded-batch executables need batch % data-axis == 0; normalize
+            # buckets up to mesh multiples (batch=1 latency work belongs in
+            # replica mode, SURVEY.md §2.1).
+            from tpuserve.parallel.mesh import pad_batch_to_mesh
+
+            aligned = sorted({pad_batch_to_mesh(b, self.meshes[0]) for b in self.cfg.batch_buckets})
+            if aligned != self.cfg.batch_buckets:
+                log.info("%s: batch buckets %s -> %s (data axis %d)",
+                         model.name, self.cfg.batch_buckets, aligned,
+                         self.meshes[0].shape["data"])
+                self.cfg.batch_buckets = aligned
+
+        self.params_per_mesh: list[Any] = []
+        self.executables: dict[tuple, list[Executable]] = {}
+        self._rr = 0  # round-robin cursor for replica mode
+        self._rr_lock = threading.Lock()
+
+    # -- startup ------------------------------------------------------------
+    def load_and_shard_params(self) -> None:
+        # Init/load on the host CPU backend, cast on host, then device_put
+        # exactly once per mesh. Reasons: (a) a host-side numpy cast
+        # (ml_dtypes handles bf16) beats dispatching hundreds of tiny convert
+        # ops; (b) on the tunneled dev TPU, reading back accelerator-side
+        # buffers flips the relay into a ~30 MB/s synchronous-transfer mode,
+        # so param init must never touch the accelerator.
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            cpu = None
+        if cpu is not None:
+            with jax.default_device(cpu):
+                params = self.model.load_params()
+        else:
+            params = self.model.load_params()
+        params = jax.device_get(params)
+        dtype = jnp.dtype(self.cfg.dtype)
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params,
+        )
+        rules = self.model.partition_rules()
+        for mesh in self.meshes:
+            specs = match_partition_rules(rules, params)
+            shardings = specs_to_shardings(specs, mesh)
+            self.params_per_mesh.append(
+                jax.tree_util.tree_map(jax.device_put, params, shardings)
+            )
+
+    def compile_all(self, pool: cf.ThreadPoolExecutor | None = None) -> None:
+        """AOT-compile every bucket (in parallel when a pool is given)."""
+        t0 = time.perf_counter()
+        buckets = self.model.buckets()
+        if pool is None:
+            for b in buckets:
+                self._compile_bucket(b)
+        else:
+            list(pool.map(self._compile_bucket, buckets))
+        log.info(
+            "%s: compiled %d bucket(s) x %d replica(s) in %.1fs",
+            self.model.name, len(buckets), len(self.meshes), time.perf_counter() - t0,
+        )
+
+    def _compile_bucket(self, bucket: tuple) -> None:
+        exes = []
+        for i, mesh in enumerate(self.meshes):
+            params = self.params_per_mesh[i]
+            batch_struct = self.model.input_signature(bucket)
+            # batch_spec is either one P applied to every leaf, or a pytree of
+            # P matching batch_struct's structure.
+            spec = self.model.batch_spec()
+            if isinstance(spec, P):
+                in_batch_sharding = jax.tree_util.tree_map(
+                    lambda _s: NamedSharding(mesh, spec), batch_struct
+                )
+            else:
+                in_batch_sharding = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), spec,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+            out_spec = self.model.out_spec()
+            if isinstance(out_spec, P):
+                out_shardings = NamedSharding(mesh, out_spec)
+            else:
+                out_shardings = jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), out_spec,
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+            param_shardings = jax.tree_util.tree_map(lambda x: x.sharding, params)
+            jitted = jax.jit(
+                self.model.forward,
+                in_shardings=(param_shardings, in_batch_sharding),
+                out_shardings=out_shardings,
+                donate_argnums=(1,),
+            )
+            params_struct = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), params
+            )
+            compiled = jitted.lower(params_struct, batch_struct).compile()
+            exes.append(Executable(bucket, compiled, in_batch_sharding, device_index=i))
+        self.executables[bucket] = exes
+
+    # -- hot path -----------------------------------------------------------
+    def pick_replica(self) -> int:
+        if len(self.meshes) == 1:
+            return 0
+        with self._rr_lock:
+            self._rr = (self._rr + 1) % len(self.meshes)
+            return self._rr
+
+    def run(self, bucket: tuple, host_batch: Any, replica: int | None = None) -> Any:
+        """H2D + async dispatch. Returns device output pytree immediately."""
+        exes = self.executables[bucket]
+        i = replica if replica is not None else self.pick_replica()
+        exe = exes[i]
+        dev_batch = jax.tree_util.tree_map(jax.device_put, host_batch, exe.batch_sharding)
+        return exe.compiled(self.params_per_mesh[i], dev_batch)
+
+    @staticmethod
+    def fetch(outputs: Any) -> Any:
+        """Block for D2H; call off the event loop."""
+        return jax.tree_util.tree_map(np.asarray, outputs)
+
+    # -- info ---------------------------------------------------------------
+    def describe(self) -> dict:
+        return {
+            "model": self.model.name,
+            "family": self.cfg.family,
+            "mode": self.mode,
+            "dtype": self.cfg.dtype,
+            "replicas": len(self.meshes),
+            "mesh_shape": dict(self.meshes[0].shape),
+            "buckets": [list(b) for b in sorted(self.executables)],
+        }
+
+
+def build_runtime(model: ServingModel, mesh: Mesh | None = None,
+                  pool: cf.ThreadPoolExecutor | None = None) -> ModelRuntime:
+    rt = ModelRuntime(model, mesh)
+    rt.load_and_shard_params()
+    rt.compile_all(pool)
+    return rt

@@ -1,0 +1,142 @@
+"""Model weight import/export (SURVEY.md §2 C6; §5 checkpoint/resume).
+
+The reference persists models as TF SavedModels executed by TF-GPU. The
+TPU-native build separates *weights* from *graphs*: graphs are always our own
+Flax modules (tpuserve.models), and this module moves weights between three
+formats:
+
+- **orbax checkpoint dir** — the native format. Fast, sharding-aware,
+  TF-free startup. Produced by ``python -m tpuserve import-model`` or
+  ``save_orbax``.
+- **TF SavedModel dir** (``saved_model.pb`` + ``variables/``) — read via
+  ``tf.saved_model.load`` on CPU; variables are extracted to a flat
+  ``name -> np.ndarray`` dict and handed to the model family's
+  ``import_tf_variables`` for name/layout translation (NHWC vs NCHW, fused
+  BN, etc.). TF import is lazy: serving from orbax never imports TF.
+- **frozen GraphDef ``.pb``** — 2016-era repos ship these; constants are
+  extracted from the graph nodes into the same flat dict.
+
+Detection is by directory shape, so ``ModelConfig.weights`` is just a path.
+Golden-output parity between the TF graph and our Flax path is asserted in
+tests (SURVEY.md §4-4), not here.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+log = logging.getLogger("tpuserve.savedmodel")
+
+
+# -- format detection --------------------------------------------------------
+
+def detect_format(path: str) -> str:
+    """'orbax' | 'saved_model' | 'graphdef'."""
+    if os.path.isdir(path):
+        if os.path.exists(os.path.join(path, "saved_model.pb")):
+            return "saved_model"
+        return "orbax"
+    if path.endswith(".pb"):
+        return "graphdef"
+    raise ValueError(f"cannot identify weight format of {path!r}")
+
+
+def load_params_for(model) -> Any:
+    """Entry point used by ServingModel.load_params when cfg.weights is set."""
+    path = model.cfg.weights
+    fmt = detect_format(path)
+    log.info("loading %s weights for %s from %s", fmt, model.name, path)
+    if fmt == "orbax":
+        return load_orbax(path, model)
+    flat = (
+        extract_saved_model_variables(path)
+        if fmt == "saved_model"
+        else extract_graphdef_constants(path)
+    )
+    return model.import_tf_variables(flat)
+
+
+# -- orbax native checkpoints ------------------------------------------------
+
+def save_orbax(path: str, params: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), jax.device_get(params))
+        ckptr.wait_until_finished()
+
+
+def load_orbax(path: str, model) -> Any:
+    """Restore with the model's own param structure as the abstract target."""
+    import orbax.checkpoint as ocp
+
+    target = jax.eval_shape(model.init_params, jax.random.key(0))
+    # Restore as host numpy; the runtime device_puts with shardings itself.
+    target = jax.tree_util.tree_map(
+        lambda s: ocp.utils.to_shape_dtype_struct(s) if hasattr(ocp, "utils") else s, target
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), target)
+
+
+# -- TF weight extraction (lazy TF import) -----------------------------------
+
+_CKPT_SUFFIX = "/.ATTRIBUTES/VARIABLE_VALUE"
+
+
+def extract_saved_model_variables(path: str) -> dict[str, np.ndarray]:
+    """Flat {path: np.ndarray} from a TF2 SavedModel's variables checkpoint.
+
+    Reads the ``variables/`` checkpoint shards directly (no graph execution,
+    no object restoration): keys are object-graph paths with the checkpoint
+    attribute suffix stripped, e.g. ``layer_1/kernel``.
+    """
+    import tensorflow as tf  # lazy: only on import paths
+
+    reader = tf.train.load_checkpoint(os.path.join(path, "variables", "variables"))
+    out: dict[str, np.ndarray] = {}
+    for key in reader.get_variable_to_shape_map():
+        name = key[: -len(_CKPT_SUFFIX)] if key.endswith(_CKPT_SUFFIX) else key
+        if name.startswith("_CHECKPOINTABLE_OBJECT_GRAPH") or "OBJECT_CONFIG" in name:
+            continue
+        out[name] = reader.get_tensor(key)
+    if not out:
+        raise ValueError(f"SavedModel at {path!r} exposes no variables")
+    return out
+
+
+def extract_graphdef_constants(path: str) -> dict[str, np.ndarray]:
+    """Flat {node_name: np.ndarray} of Const nodes from a frozen GraphDef."""
+    import tensorflow as tf
+
+    gd = tf.compat.v1.GraphDef()
+    with open(path, "rb") as f:
+        gd.ParseFromString(f.read())
+    out: dict[str, np.ndarray] = {}
+    for node in gd.node:
+        if node.op == "Const":
+            t = node.attr["value"].tensor
+            out[node.name] = np.array(tf.make_ndarray(t))
+    if not out:
+        raise ValueError(f"GraphDef at {path!r} has no Const nodes")
+    return out
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def convert_cli(saved_model_path: str, family: str, out_path: str) -> None:
+    """SavedModel/GraphDef -> orbax, so serving startup never needs TF."""
+    from tpuserve.config import ModelConfig
+    from tpuserve import models as modelzoo
+
+    cfg = ModelConfig(name=family, family=family, weights=saved_model_path)
+    model = modelzoo.build(cfg)
+    params = load_params_for(model)
+    save_orbax(out_path, params)
+    log.info("wrote orbax checkpoint to %s", out_path)
+    print(f"converted {saved_model_path} -> {out_path}")

@@ -1,0 +1,236 @@
+"""HTTP serving layer (SURVEY.md §2 C1, §3c).
+
+The reference's web layer is a Flask/WSGI predict handler (BASELINE.json);
+threads + blocking handlers don't suit a batching TPU server, so this layer is
+a single asyncio event loop (aiohttp) where handlers only:
+
+1. read the body,
+2. decode it in the shared threadpool (``model.host_decode``),
+3. submit to the batcher and await the per-request Future,
+4. JSON-encode the result.
+
+All device work happens behind the batcher. Endpoints:
+
+- ``POST /v1/models/{name}:predict`` (aliases ``:classify``, ``:detect``,
+  ``:generate``) — body is an image (``image/jpeg``, ``image/png``,
+  ``application/x-npy``) or JSON (``{"text": ...}``, ``{"prompt": ...}``).
+- ``GET  /healthz``     — liveness + per-model canary status.
+- ``GET  /metrics``     — Prometheus text format.
+- ``GET  /stats``       — JSON latency/throughput summary.
+- ``GET  /debug/trace`` — Chrome trace JSON of recent request spans.
+- ``GET  /v1/models``   — model inventory (buckets, mesh, dtype).
+- ``GET  /``            — minimal HTML upload page for manual poking.
+
+Error mapping: decode failure -> 400, unknown model -> 404, queue full -> 429,
+request deadline exceeded -> 504, batch failure -> 500.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures as cf
+import json
+import logging
+import time
+
+from aiohttp import web
+
+import jax
+
+from tpuserve import models as modelzoo
+from tpuserve.batcher import ModelBatcher, QueueFull
+from tpuserve.config import ServerConfig
+from tpuserve.obs import Metrics
+from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
+
+log = logging.getLogger("tpuserve.server")
+
+_VERBS = ("predict", "classify", "detect", "generate")
+
+
+class ServerState:
+    """Everything a running server owns."""
+
+    def __init__(self, cfg: ServerConfig) -> None:
+        self.cfg = cfg
+        self.metrics = Metrics(cfg.trace_capacity)
+        self.pool = cf.ThreadPoolExecutor(max_workers=cfg.decode_threads, thread_name_prefix="tpuserve")
+        self.models: dict[str, object] = {}
+        self.runtimes: dict[str, ModelRuntime] = {}
+        self.batchers: dict[str, ModelBatcher] = {}
+        self.canary_ok: dict[str, bool] = {}
+
+    def build(self) -> None:
+        configure_jax(self.cfg)
+        if self.cfg.profiler_port:
+            jax.profiler.start_server(self.cfg.profiler_port)
+        compile_pool = cf.ThreadPoolExecutor(max_workers=4, thread_name_prefix="compile")
+        try:
+            for mcfg in self.cfg.models:
+                t0 = time.perf_counter()
+                model = modelzoo.build(mcfg)
+                rt = build_runtime(model, pool=compile_pool)
+                self.models[mcfg.name] = model
+                self.runtimes[mcfg.name] = rt
+                log.info("model %s ready in %.1fs: %s", mcfg.name, time.perf_counter() - t0, rt.describe())
+        finally:
+            compile_pool.shutdown()
+
+    async def start(self) -> None:
+        for name, model in self.models.items():
+            b = ModelBatcher(model, self.runtimes[name], self.metrics, self.pool)
+            await b.start()
+            self.batchers[name] = b
+        if self.cfg.startup_canary:
+            await self.run_canaries()
+
+    async def run_canaries(self) -> None:
+        """Tiny end-to-end inference per model; feeds /healthz."""
+        for name, model in self.models.items():
+            try:
+                item = model.canary_item()
+                fut = self.batchers[name].submit(item, group=model.group_key(item))
+                await asyncio.wait_for(fut, timeout=60.0)
+                self.canary_ok[name] = True
+            except Exception:
+                log.exception("canary failed for %s", name)
+                self.canary_ok[name] = False
+
+    async def stop(self) -> None:
+        for b in self.batchers.values():
+            await b.stop()
+        self.pool.shutdown(wait=False, cancel_futures=True)
+
+
+# -- handlers ----------------------------------------------------------------
+
+async def handle_predict(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    name = request.match_info["name"]
+    model = state.models.get(name)
+    if model is None:
+        return _err(404, f"unknown model {name!r}")
+    mcfg = state.cfg.model(name)
+    metrics = state.metrics
+    metrics.counter(f"requests_total{{model={name}}}").inc()
+    t_start = time.perf_counter()
+
+    body = await request.read()
+    ctype = request.content_type or ""
+    loop = asyncio.get_running_loop()
+    try:
+        item = await loop.run_in_executor(state.pool, model.host_decode, body, ctype)
+    except Exception as e:
+        metrics.counter(f"bad_requests_total{{model={name}}}").inc()
+        return _err(400, f"could not decode request: {e}")
+
+    try:
+        fut = state.batchers[name].submit(item, group=model.group_key(item))
+    except QueueFull:
+        return _err(429, "queue full, retry later")
+
+    try:
+        timeout = mcfg.request_timeout_ms / 1e3
+        result = await asyncio.wait_for(fut, timeout=timeout)
+    except asyncio.TimeoutError:
+        fut.cancel()
+        metrics.counter(f"timeouts_total{{model={name}}}").inc()
+        return _err(504, f"request deadline ({mcfg.request_timeout_ms} ms) exceeded")
+    except Exception as e:
+        return _err(500, f"inference failed: {e}")
+
+    total_ms = (time.perf_counter() - t_start) * 1e3
+    metrics.observe_phase(name, "total", total_ms)
+    if isinstance(result, bytes):  # e.g. SD PNG output
+        return web.Response(body=result, content_type="image/png")
+    return web.json_response(result)
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.json_response({n: rt.describe() for n, rt in state.runtimes.items()})
+
+
+async def handle_healthz(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    ok = all(state.canary_ok.values()) if state.canary_ok else True
+    return web.json_response(
+        {"status": "ok" if ok else "degraded", "models": state.canary_ok},
+        status=200 if ok else 503,
+    )
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.Response(text=state.metrics.render_prometheus(), content_type="text/plain")
+
+
+async def handle_stats(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.json_response(state.metrics.summary())
+
+
+async def handle_trace(request: web.Request) -> web.Response:
+    state: ServerState = request.app["state"]
+    return web.Response(text=state.metrics.tracer.chrome_trace(), content_type="application/json")
+
+
+_INDEX_HTML = """<!doctype html><title>tpuserve</title>
+<h1>tpuserve</h1>
+<p>POST an image to <code>/v1/models/&lt;name&gt;:classify</code>.
+See <a href="/v1/models">models</a>, <a href="/metrics">metrics</a>,
+<a href="/stats">stats</a>, <a href="/healthz">health</a>.</p>
+<form method=post enctype=multipart/form-data onsubmit="
+  event.preventDefault();
+  const f=document.getElementById('f').files[0];
+  const m=document.getElementById('m').value;
+  fetch('/v1/models/'+m+':predict',{method:'POST',body:f,
+    headers:{'Content-Type':f.type}})
+   .then(r=>r.json()).then(j=>document.getElementById('out').textContent=
+     JSON.stringify(j,null,2));
+">
+<input type=text id=m value=resnet50> <input type=file id=f>
+<button>predict</button></form><pre id=out></pre>
+"""
+
+
+async def handle_index(request: web.Request) -> web.Response:
+    return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+
+def _err(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+# -- app wiring --------------------------------------------------------------
+
+def make_app(state: ServerState) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app["state"] = state
+    for verb in _VERBS:
+        app.router.add_post(f"/v1/models/{{name}}:{verb}", handle_predict)
+    app.router.add_get("/v1/models", handle_models)
+    app.router.add_get("/healthz", handle_healthz)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/stats", handle_stats)
+    app.router.add_get("/debug/trace", handle_trace)
+    app.router.add_get("/", handle_index)
+
+    async def on_startup(app: web.Application) -> None:
+        await state.start()
+
+    async def on_cleanup(app: web.Application) -> None:
+        await state.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def serve(cfg: ServerConfig) -> None:
+    """Blocking entry point: build models, compile, serve."""
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+    web.run_app(app, host=cfg.host, port=cfg.port, access_log=None)

@@ -1,0 +1,3 @@
+"""Small shared utilities."""
+
+from tpuserve.utils.trees import tree_size_bytes, tree_summary  # noqa: F401

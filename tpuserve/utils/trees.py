@@ -1,0 +1,26 @@
+"""Pytree helpers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def tree_size_bytes(tree: Any) -> int:
+    """Total bytes across all array leaves."""
+    return sum(
+        int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+        for x in jax.tree_util.tree_leaves(tree)
+        if hasattr(x, "shape")
+    )
+
+
+def tree_summary(tree: Any) -> dict:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return {
+        "leaves": len(leaves),
+        "bytes": tree_size_bytes(tree),
+        "params": sum(int(np.prod(x.shape)) for x in leaves if hasattr(x, "shape")),
+    }
