@@ -1,0 +1,167 @@
+/* jpegyuv — minimal libjpeg shim that decodes baseline JPEGs straight to
+ * YUV 4:2:0 planes, skipping chroma upsampling and YCbCr->RGB conversion.
+ *
+ * Why (SURVEY.md §2 C12): the serving host ships image bytes to the TPU over
+ * a bandwidth-limited link; a JPEG already stores YCbCr with 2x2-subsampled
+ * chroma, so shipping the raw planes is byte-identical information at half
+ * the bytes of RGB8 (1.5 B/px vs 3 B/px). The YCbCr->RGB conversion + chroma
+ * upsample run on-device, fused into the model executable
+ * (tpuserve/preproc.py:device_prepare_images_yuv420). Skipping libjpeg's own
+ * upsample/color stages also makes this decode ~2x cheaper than a full RGB
+ * decode — which matters on a small serving host.
+ *
+ * API (ctypes-friendly, no Python.h):
+ *   jpegyuv_decode(buf, len, y, u, v, edge) -> 0 ok / negative error
+ *     Decodes into caller-allocated planes: y[edge*edge],
+ *     u,v[(edge/2)*(edge/2)]. The JPEG must be edge x edge (the server's
+ *     wire contract; mismatches return -3 and the caller falls back to the
+ *     PIL path).  Non-4:2:0 files (incl. grayscale) return -4; 4:4:4 etc.
+ *     fall back host-side.
+ *   jpegyuv_probe(buf, len, &w, &h, &subsamp) -> 0/neg: header-only probe.
+ *
+ * Thread-safe: one jpeg_decompress_struct per call, no globals; the GIL is
+ * released by ctypes during the call, so decode threads scale.
+ */
+
+#include <setjmp.h>
+#include <stdint.h>
+#include <string.h>
+#include <stdio.h>
+#include <jpeglib.h>
+
+struct jy_err {
+    struct jpeg_error_mgr mgr;
+    jmp_buf jb;
+};
+
+static void jy_error_exit(j_common_ptr cinfo) {
+    struct jy_err *err = (struct jy_err *)cinfo->err;
+    longjmp(err->jb, 1);
+}
+
+static void jy_emit_message(j_common_ptr cinfo, int msg_level) {
+    (void)cinfo; (void)msg_level; /* quiet */
+}
+
+int jpegyuv_probe(const uint8_t *buf, long len, int *w, int *h, int *subsamp) {
+    struct jpeg_decompress_struct cinfo;
+    struct jy_err jerr;
+
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jy_error_exit;
+    jerr.mgr.emit_message = jy_emit_message;
+    if (setjmp(jerr.jb)) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, buf, (unsigned long)len);
+    if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+        jpeg_destroy_decompress(&cinfo);
+        return -2;
+    }
+    *w = (int)cinfo.image_width;
+    *h = (int)cinfo.image_height;
+    /* subsamp: 420 iff 3 components, comp0 2x2 sampling, comp1/2 1x1 */
+    *subsamp = 0;
+    if (cinfo.num_components == 3 &&
+        cinfo.comp_info[0].h_samp_factor == 2 &&
+        cinfo.comp_info[0].v_samp_factor == 2 &&
+        cinfo.comp_info[1].h_samp_factor == 1 &&
+        cinfo.comp_info[1].v_samp_factor == 1 &&
+        cinfo.comp_info[2].h_samp_factor == 1 &&
+        cinfo.comp_info[2].v_samp_factor == 1)
+        *subsamp = 420;
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+int jpegyuv_decode(const uint8_t *buf, long len,
+                   uint8_t *y, uint8_t *u, uint8_t *v, int edge) {
+    struct jpeg_decompress_struct cinfo;
+    struct jy_err jerr;
+    int half = edge / 2;
+
+    if (edge <= 0 || (edge & 15) != 0)
+        return -5; /* wire edges are multiples of 16 (full MCU rows) */
+
+    cinfo.err = jpeg_std_error(&jerr.mgr);
+    jerr.mgr.error_exit = jy_error_exit;
+    jerr.mgr.emit_message = jy_emit_message;
+    if (setjmp(jerr.jb)) {
+        jpeg_destroy_decompress(&cinfo);
+        return -1;
+    }
+    jpeg_create_decompress(&cinfo);
+    jpeg_mem_src(&cinfo, buf, (unsigned long)len);
+    if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+        jpeg_destroy_decompress(&cinfo);
+        return -2;
+    }
+    if ((int)cinfo.image_width != edge || (int)cinfo.image_height != edge) {
+        jpeg_destroy_decompress(&cinfo);
+        return -3;
+    }
+    if (!(cinfo.num_components == 3 &&
+          cinfo.comp_info[0].h_samp_factor == 2 &&
+          cinfo.comp_info[0].v_samp_factor == 2 &&
+          cinfo.comp_info[1].h_samp_factor == 1 &&
+          cinfo.comp_info[1].v_samp_factor == 1 &&
+          cinfo.comp_info[2].h_samp_factor == 1 &&
+          cinfo.comp_info[2].v_samp_factor == 1)) {
+        jpeg_destroy_decompress(&cinfo);
+        return -4; /* not 4:2:0; caller falls back */
+    }
+
+    cinfo.raw_data_out = TRUE;
+    cinfo.do_fancy_upsampling = FALSE;
+    jpeg_start_decompress(&cinfo);
+
+    /* raw_data_out delivers one MCU row (16 luma lines / 8 chroma lines)
+     * per call, as JSAMPROW pointer tables into the destination planes. */
+    {
+        JSAMPROW yrows[16], urows[8], vrows[8];
+        JSAMPARRAY planes[3] = {yrows, urows, vrows};
+        unsigned int lines_per_mcu = cinfo.max_v_samp_factor * DCTSIZE; /* 16 */
+
+        while (cinfo.output_scanline < cinfo.output_height) {
+            unsigned int base = cinfo.output_scanline;
+            unsigned int i;
+            for (i = 0; i < 16; i++) {
+                unsigned int row = base + i;
+                yrows[i] = y + (row < (unsigned)edge ? row : (unsigned)edge - 1) * (size_t)edge;
+            }
+            for (i = 0; i < 8; i++) {
+                unsigned int row = base / 2 + i;
+                urows[i] = u + (row < (unsigned)half ? row : (unsigned)half - 1) * (size_t)half;
+                vrows[i] = v + (row < (unsigned)half ? row : (unsigned)half - 1) * (size_t)half;
+            }
+            if (jpeg_read_raw_data(&cinfo, planes, lines_per_mcu) == 0)
+                break;
+        }
+    }
+
+    jpeg_finish_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+}
+
+/* Batched variant: decode n same-sized JPEGs into contiguous plane batches.
+ * offsets[i]/lengths[i] describe JPEG i inside buf. Returns the number
+ * decoded OK; per-image status goes into status[i] (0 ok / negative). */
+int jpegyuv_decode_batch(const uint8_t *buf, const long *offsets,
+                         const long *lengths, int n,
+                         uint8_t *y, uint8_t *u, uint8_t *v,
+                         int edge, int *status) {
+    int half = edge / 2;
+    size_t ysz = (size_t)edge * edge, csz = (size_t)half * half;
+    int ok = 0, i;
+    for (i = 0; i < n; i++) {
+        status[i] = jpegyuv_decode(buf + offsets[i], lengths[i],
+                                   y + (size_t)i * ysz,
+                                   u + (size_t)i * csz,
+                                   v + (size_t)i * csz, edge);
+        if (status[i] == 0) ok++;
+    }
+    return ok;
+}

@@ -55,12 +55,15 @@ class ModelBatcher:
     def __init__(
         self,
         model: ServingModel,
-        runtime: ModelRuntime,
+        runtime: "ModelRuntime | Any",
         metrics: Metrics,
         pool: cf.ThreadPoolExecutor,
     ) -> None:
         self.model = model
         self.runtime = runtime
+        # Deferred-readback pool (tpuserve.deferred.DeferredPool) instead of
+        # an in-process runtime: dispatch awaits epoch readback.
+        self.deferred = hasattr(runtime, "run_deferred")
         self.metrics = metrics
         self.pool = pool
         self.cfg = model.cfg
@@ -153,6 +156,7 @@ class ModelBatcher:
     async def _dispatch(self, reqs: list[_Request], group: Hashable) -> None:
         loop = asyncio.get_running_loop()
         name = self.model.name
+        sem_released = False
         try:
             bucket = self.model.bucket_for(len(reqs), group=group)
             fill = len(reqs) / bucket[0]
@@ -170,13 +174,28 @@ class ModelBatcher:
             if self.fault_hook is not None:
                 self.fault_hook()
 
-            outputs = await loop.run_in_executor(self.pool, self.runtime.run, bucket, host_batch)
-            t2 = time.perf_counter()
-            self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+            if self.deferred:
+                # Deferred mode: enqueue is cheap (shm write + slot wait = the
+                # backpressure), so the inflight semaphore is released as soon
+                # as the batch is on its worker; the await then spans the rest
+                # of the owning worker's epoch + bulk readback, which is what
+                # "compute" measures in this mode by design.
+                out_fut = await self.runtime.enqueue(bucket, host_batch)
+                t2 = time.perf_counter()
+                self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+                self._inflight.release()
+                sem_released = True
+                np_out = await out_fut
+                t3 = time.perf_counter()
+                self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+            else:
+                outputs = await loop.run_in_executor(self.pool, self.runtime.run, bucket, host_batch)
+                t2 = time.perf_counter()
+                self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
 
-            np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
-            t3 = time.perf_counter()
-            self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+                np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
+                t3 = time.perf_counter()
+                self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
 
             results = self.model.host_postprocess(np_out, len(reqs))
             t4 = time.perf_counter()
@@ -196,4 +215,5 @@ class ModelBatcher:
                 if not r.future.done():
                     r.future.set_exception(e)
         finally:
-            self._inflight.release()
+            if not sem_released:
+                self._inflight.release()

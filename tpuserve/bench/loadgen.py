@@ -1,9 +1,16 @@
 """Asyncio HTTP load generator (SURVEY.md §2 C11).
 
-Closed-loop with fixed concurrency: C workers each keep exactly one request
-in flight, recording per-request latency. Reports throughput (items/s), p50,
-p99 — the BASELINE.md metrics. Used by ``python -m tpuserve bench`` and by
-the repo-root ``bench.py`` harness.
+Two modes (VERDICT.md r1 item 3):
+
+- **Closed loop** (``run_load``): C workers each keep exactly one request in
+  flight. Measures peak sustainable throughput; its p50 is queueing delay by
+  Little's law, NOT server latency.
+- **Open loop** (``run_load_open``): requests are issued on a fixed-rate
+  clock regardless of completions, like independent clients. Latency
+  percentiles at a stated offered rate are the honest latency metric.
+
+Both record only requests that *complete inside* the measurement window and
+divide by the actual window, so stragglers can't inflate throughput.
 """
 
 from __future__ import annotations

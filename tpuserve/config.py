@@ -54,6 +54,13 @@ class ModelConfig:
     # uint8; the device resizes to image_size. Smaller wire = fewer PCIe (or
     # dev-tunnel) bytes; 256 leaves headroom for crop-style augmentation.
     wire_size: int = 256
+    # Wire encoding for images crossing host->device:
+    # - "rgb8":   (wire, wire, 3) uint8 — 3 B/px.
+    # - "yuv420": raw JPEG planes (full-res Y + 2x2-subsampled Cb/Cr) —
+    #   1.5 B/px, half the transfer bytes with no extra fidelity loss (a JPEG
+    #   stores exactly these planes); color conversion happens on device
+    #   (preproc.device_prepare_images_yuv420). Requires wire_size % 16 == 0.
+    wire_format: str = "rgb8"
     # Parallelism mode: "sharded" (one executable, batch sharded over the
     # mesh), "replica" (one executable per device, independent queues), or
     # "single" (first device only). SURVEY.md §2.1.
@@ -67,6 +74,22 @@ class ModelConfig:
     num_classes: int = 1000
     # Number of in-flight device batches the dispatcher pipelines (>=1).
     max_inflight: int = 2
+    # Execution mode (SURVEY.md C5; tpuserve/deferred.py):
+    # - "direct":  per-batch dispatch + readback in-process (real TPU / CPU).
+    # - "recycle": deferred-readback worker pool — results are read back in
+    #   bulk once per epoch by single-use worker processes. For links where
+    #   per-batch device->host reads destroy throughput (see BASELINE.md
+    #   "relay physics").
+    session_mode: str = "direct"
+    # recycle mode: worker processes to pre-warm at startup.
+    relay_workers: int = 2
+    # recycle mode: epoch budget — a worker retires after this many image
+    # rows, or relay_epoch_ms after its first batch, whichever first. Bounds
+    # result latency.
+    relay_epoch_images: int = 4096
+    relay_epoch_ms: float = 2000.0
+    # recycle mode: per-worker shared-memory batch slots (in-flight batches).
+    relay_slots: int = 4
 
 
 @dataclass

@@ -1,0 +1,519 @@
+"""Deferred-readback execution pool (SURVEY.md §2 C5/C12; VERDICT.md r1 item 2).
+
+Motivation — measured on the dev tunnel (see BASELINE.md "relay physics"):
+the PJRT relay that fronts the TPU buffers host->device transfers at memcpy
+speed (~2 GB/s apparent) and drains them to the device at the link's real
+rate (~47 MB/s), but the FIRST device->host read permanently switches the
+session into a synchronous mode (~115 ms fixed cost per transfer, no
+pipelining). A serving process that reads results after every batch therefore
+runs an order of magnitude under the link rate.
+
+The TPU-native answer is to make device->host readback *rare* instead of
+per-batch:
+
+- **Worker processes** own one PJRT session each. A worker AOT-compiles the
+  model (shared persistent XLA cache), then serves an *epoch* of batches
+  append-only: every forward's outputs land in a device-resident accumulator
+  via a donated `lax.dynamic_update_slice` executable — zero device->host
+  traffic during the epoch.
+- At **retirement** the worker does ONE bulk read of the accumulator (the
+  only moment its session flips), ships the rows back over shared memory,
+  and exits. A pre-warmed successor is already serving by then, so the drain
+  overlaps the next epoch's compute.
+- The **pool** (in the server process) routes batches to the active worker
+  over shared-memory slots, rotates workers on an image/deadline budget, and
+  resolves per-batch futures when the owning worker's rows arrive.
+
+On real TPU hardware (no relay) set `session_mode = "direct"` and the
+ordinary per-batch runtime path is used; "recycle" trades result latency
+(bounded by `relay_epoch_ms`) for wire efficiency. The batcher API is the
+same in both modes.
+
+Protocol (pipe carries control, shared memory carries data):
+
+    pool (server proc)                    worker proc (one PJRT session)
+    ------------------                    ------------------------------
+    fork()  ──────────────────────────▶   build model, AOT compile buckets,
+                                          upload params, compile appends
+    ◀─ {"op": "ready"} ────────────────
+    write batch planes into shm slot
+    ── {"op":"batch", slot, off} ─────▶   view slot (zero copy), device_put,
+                                          forward, append(accum, off)
+    ◀─ {"op":"ack", slot} ─────────────   (slot reusable)
+    ── {"op":"retire"} ───────────────▶   np.asarray(accum)  ← the one read
+    ◀─ {"op":"results", shm, shapes} ──   rows in a results shm it created
+    scatter rows to batch futures
+    ── {"op":"bye"} ──────────────────▶   unlink results shm, exit
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import multiprocessing as mp
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from multiprocessing import shared_memory
+from typing import Any
+
+import numpy as np
+
+from tpuserve.config import ModelConfig
+
+log = logging.getLogger("tpuserve.deferred")
+
+
+# ---------------------------------------------------------------------------
+# Worker process
+# ---------------------------------------------------------------------------
+
+def _worker_main(mcfg: ModelConfig, cache_dir: str, conn,
+                 batch_shm_name: str, slot_bytes: int, cap_rows: int) -> None:
+    """Worker entry: one PJRT session, one epoch of batches, one readback."""
+    try:
+        _worker_run(mcfg, cache_dir, conn, batch_shm_name, slot_bytes, cap_rows)
+    except Exception as e:  # noqa: BLE001 — report any death to the pool
+        try:
+            conn.send({"op": "died", "error": f"{type(e).__name__}: {e}"})
+        except Exception:
+            pass
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def _worker_run(mcfg, cache_dir, conn, batch_shm_name, slot_bytes, cap_rows) -> None:
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    # Spawned children re-run sitecustomize, which may re-force a hardware
+    # platform via jax.config; re-assert the env's platform choice before any
+    # backend init (mirrors tests/conftest.py).
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from tpuserve.models import build
+    from tpuserve.runtime import ModelRuntime
+
+    model = build(mcfg)
+    rt = ModelRuntime(model)
+    rt.load_and_shard_params()
+    rt.compile_all()
+    params = rt.params_per_mesh[0]
+
+    # Output row structure (shapes past the batch dim are bucket-independent).
+    sample_sig = model.input_signature(model.buckets()[0])
+    out_struct = jax.eval_shape(model.forward, params, sample_sig)
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out_struct)
+
+    acc = [
+        jax.device_put(jnp.zeros((cap_rows,) + tuple(l.shape[1:]), l.dtype))
+        for l in out_leaves
+    ]
+
+    def _append(acc_list, outs_list, off):
+        return [
+            jax.lax.dynamic_update_slice(a, o.astype(a.dtype), (off,) + (0,) * (a.ndim - 1))
+            for a, o in zip(acc_list, outs_list)
+        ]
+
+    acc_struct = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in acc]
+    appends = {}
+    for bucket in model.buckets():
+        sig = model.input_signature(bucket)
+        bstruct = jax.tree_util.tree_flatten(
+            jax.eval_shape(model.forward, params, sig))[0]
+        appends[bucket] = (
+            jax.jit(_append, donate_argnums=(0,))
+            .lower(acc_struct, bstruct, jax.ShapeDtypeStruct((), jnp.int32))
+            .compile()
+        )
+
+    batch_shm = shared_memory.SharedMemory(name=batch_shm_name)
+    sig_cache = {b: model.input_signature(b) for b in model.buckets()}
+    conn.send({"op": "ready"})
+
+    results_shm = None
+    try:
+        while True:
+            msg = conn.recv()
+            op = msg["op"]
+            if op == "batch":
+                bucket = tuple(msg["bucket"])
+                slot, off = msg["slot"], msg["off"]
+                views = _views_from_slot(batch_shm.buf, slot * slot_bytes,
+                                         sig_cache[bucket])
+                dev_batch = jax.tree_util.tree_map(jax.device_put, views)
+                out = rt.executables[bucket][0].compiled(params, dev_batch)
+                acc = appends[bucket](acc, jax.tree_util.tree_flatten(out)[0],
+                                      jnp.int32(off))
+                conn.send({"op": "ack", "slot": slot})
+            elif op == "retire":
+                jax.block_until_ready(acc)
+                t0 = time.perf_counter()
+                host = [np.asarray(a) for a in acc]  # THE readback
+                read_s = time.perf_counter() - t0
+                total = sum(h.nbytes for h in host)
+                results_shm = shared_memory.SharedMemory(create=True,
+                                                         size=max(1, total))
+                offb = 0
+                shapes = []
+                for h in host:
+                    flat = np.frombuffer(results_shm.buf, dtype=np.uint8,
+                                         count=h.nbytes, offset=offb)
+                    flat[:] = h.reshape(-1).view(np.uint8)
+                    shapes.append((h.shape, str(h.dtype), offb))
+                    offb += h.nbytes
+                conn.send({"op": "results", "shm": results_shm.name,
+                           "shapes": shapes,
+                           "treedef": pickle.dumps(out_treedef),
+                           "read_s": read_s})
+                conn.recv()  # "bye": pool has copied the rows out
+                return
+            elif op == "bye":
+                return
+    finally:
+        batch_shm.close()
+        if results_shm is not None:
+            results_shm.close()
+            results_shm.unlink()
+
+
+def _views_from_slot(buf, base: int, sig) -> Any:
+    """Zero-copy numpy views into a shm slot, laid out leaf-after-leaf."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(sig)
+    views = []
+    off = base
+    for l in leaves:
+        count = int(np.prod(l.shape))
+        views.append(np.frombuffer(buf, dtype=l.dtype, count=count,
+                                   offset=off).reshape(l.shape))
+        off += count * np.dtype(l.dtype).itemsize
+    return jax.tree_util.tree_unflatten(treedef, views)
+
+
+# ---------------------------------------------------------------------------
+# Pool (server process)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _PendingBatch:
+    off: int
+    bucket: tuple
+    future: asyncio.Future = field(repr=False)
+
+
+class _Worker:
+    """Supervisor-side handle for one worker process."""
+
+    def __init__(self, mcfg: ModelConfig, cache_dir: str, slot_bytes: int,
+                 n_slots: int, cap_rows: int, wid: int) -> None:
+        self.wid = wid
+        self.rows_used = 0
+        self.first_batch_t: float | None = None
+        self.pending: list[_PendingBatch] = []
+        self.free_slots: list[int] = list(range(n_slots))
+        self.is_ready = False
+        self.retired = False
+        self.batch_shm = shared_memory.SharedMemory(create=True,
+                                                    size=slot_bytes * n_slots)
+        # fork is cheap (inherits warmed imports) and safe while this process
+        # has no live XLA backend; once one exists (e.g. direct-mode models or
+        # a test harness touched the device), forked children would inherit
+        # its threads/locks mid-state — use spawn then.
+        ctx = mp.get_context("spawn" if _backend_live() else "fork")
+        self.conn, child_conn = ctx.Pipe()
+        self.proc = ctx.Process(
+            target=_worker_main,
+            args=(mcfg, cache_dir, child_conn, self.batch_shm.name,
+                  slot_bytes, cap_rows),
+            daemon=True,
+        )
+        self.proc.start()
+        child_conn.close()
+
+    def close(self) -> None:
+        try:
+            self.batch_shm.close()
+            self.batch_shm.unlink()
+        except Exception:
+            pass
+        if self.proc.is_alive():
+            self.proc.terminate()
+
+
+class DeferredPool:
+    """Routes batches to session-recycling workers; resolves futures on epoch
+    readback. One pool per recycle-mode model."""
+
+    def __init__(self, mcfg: ModelConfig, cache_dir: str, model) -> None:
+        import jax
+
+        self.mcfg = mcfg
+        self.cache_dir = cache_dir
+        self.model = model
+        self.n_workers = max(2, mcfg.relay_workers)
+        self.n_slots = mcfg.relay_slots
+        self.cap_rows = mcfg.relay_epoch_images
+        self.epoch_s = mcfg.relay_epoch_ms / 1e3
+        sig = model.input_signature(model.bucket_for(max(mcfg.batch_buckets)))
+        self.slot_bytes = sum(
+            int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_flatten(sig)[0]
+        )
+        self._workers: list[_Worker] = []
+        self._active: _Worker | None = None
+        self._warm: list[_Worker] = []
+        self._next_wid = 0
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._lock: asyncio.Lock | None = None
+        self._slot_waiters: dict[int, asyncio.Event] = {}
+        self.stats = {"epochs": 0, "read_s_total": 0.0, "worker_respawns": 0,
+                      "rows_total": 0}
+
+    # -- lifecycle -----------------------------------------------------------
+    def prewarm(self, n: int | None = None) -> None:
+        """Fork n workers before serving. The first is warmed alone so it
+        populates the persistent compile cache; the rest then hit it."""
+        n = n or self.n_workers
+        first = self._spawn()
+        self._wait_ready_sync(first)
+        rest = [self._spawn() for _ in range(n - 1)]
+        for w in rest:
+            self._wait_ready_sync(w)
+
+    def _spawn(self) -> _Worker:
+        w = _Worker(self.mcfg, self.cache_dir, self.slot_bytes, self.n_slots,
+                    self.cap_rows, self._next_wid)
+        self._next_wid += 1
+        self._workers.append(w)
+        self._warm.append(w)
+        return w
+
+    def _wait_ready_sync(self, w: _Worker, timeout: float = 900.0) -> None:
+        if w.conn.poll(timeout):
+            msg = w.conn.recv()
+            if msg.get("op") == "ready":
+                w.is_ready = True
+                return
+            raise RuntimeError(f"worker {w.wid} failed at warmup: {msg}")
+        raise TimeoutError(f"worker {w.wid} not ready after {timeout}s")
+
+    def _next_warm(self) -> _Worker | None:
+        while self._warm:
+            w = self._warm.pop(0)
+            if w.is_ready and w.proc.is_alive():
+                return w
+            w.close()
+        return None
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._lock = asyncio.Lock()
+        for w in self._workers:
+            self._start_reader(w)
+
+    def _start_reader(self, w: _Worker) -> None:
+        threading.Thread(target=self._reader, args=(w,), daemon=True,
+                         name=f"deferred-r{w.wid}").start()
+
+    def _reader(self, w: _Worker) -> None:
+        """Blocking pipe reader (one thread per worker, mostly idle)."""
+        try:
+            while True:
+                msg = w.conn.recv()
+                self._loop.call_soon_threadsafe(self._on_msg, w, msg)
+                if msg["op"] in ("results", "died"):
+                    return
+        except (EOFError, OSError):
+            self._loop.call_soon_threadsafe(self._on_msg, w,
+                                            {"op": "died", "error": "pipe closed"})
+
+    # -- serving -------------------------------------------------------------
+    async def enqueue(self, bucket: tuple, host_batch: Any) -> asyncio.Future:
+        """Write one assembled batch to the active worker and return a Future
+        of its np output pytree, resolved at the worker's epoch readback.
+        Blocks only for a free shm slot (backpressure)."""
+        async with self._lock:
+            while True:
+                w = await self._ensure_active(bucket)
+                try:
+                    slot = await self._take_slot(w)
+                    break
+                except _WorkerGone:
+                    continue
+            self._write_slot(w, slot, host_batch)
+            off = w.rows_used
+            w.rows_used += bucket[0]
+            self.stats["rows_total"] += bucket[0]
+            if w.first_batch_t is None:
+                w.first_batch_t = time.perf_counter()
+                self._loop.call_later(self.epoch_s, self._epoch_deadline, w)
+            fut = self._loop.create_future()
+            w.pending.append(_PendingBatch(off, bucket, fut))
+            w.conn.send({"op": "batch", "slot": slot, "off": off,
+                         "bucket": list(bucket)})
+        return fut
+
+    async def run_deferred(self, bucket: tuple, host_batch: Any) -> Any:
+        """Enqueue + await the epoch readback (convenience for tests)."""
+        return await (await self.enqueue(bucket, host_batch))
+
+    async def _ensure_active(self, bucket: tuple) -> _Worker:
+        w = self._active
+        if w is not None and not w.retired and w.proc.is_alive() \
+           and w.rows_used + bucket[0] <= self.cap_rows:
+            return w
+        if w is not None and not w.retired and w.proc.is_alive():
+            self._retire(w)
+        self._active = self._next_warm()
+        if self._active is None:
+            # Pool ran dry: spawn synchronously in a thread (slow — prewarm
+            # more workers if this shows up in stats).
+            self.stats["worker_respawns"] += 1
+            self._active = await self._loop.run_in_executor(None, self._spawn_blocking)
+            self._warm.remove(self._active)
+            self._start_reader(self._active)
+        return self._active
+
+    def _spawn_blocking(self) -> _Worker:
+        w = self._spawn()
+        self._wait_ready_sync(w)
+        return w
+
+    async def _take_slot(self, w: _Worker) -> int:
+        while not w.free_slots:
+            ev = asyncio.Event()
+            self._slot_waiters[w.wid] = ev
+            await ev.wait()
+            if w.retired or not w.proc.is_alive():
+                raise _WorkerGone()
+        return w.free_slots.pop()
+
+    def _write_slot(self, w: _Worker, slot: int, host_batch: Any) -> None:
+        import jax
+
+        leaves = jax.tree_util.tree_flatten(host_batch)[0]
+        off = slot * self.slot_bytes
+        for leaf in leaves:
+            b = np.ascontiguousarray(leaf)
+            view = np.frombuffer(w.batch_shm.buf, dtype=np.uint8,
+                                 count=b.nbytes, offset=off)
+            view[:] = b.reshape(-1).view(np.uint8)
+            off += b.nbytes
+
+    def _epoch_deadline(self, w: _Worker) -> None:
+        if not w.retired and w.proc.is_alive() and w.pending:
+            self._retire(w)
+            if self._active is w:
+                self._active = None
+
+    def _retire(self, w: _Worker) -> None:
+        w.retired = True
+        try:
+            w.conn.send({"op": "retire"})
+        except (BrokenPipeError, OSError):
+            pass
+        self._wake_slot_waiter(w)
+
+    def _wake_slot_waiter(self, w: _Worker) -> None:
+        ev = self._slot_waiters.pop(w.wid, None)
+        if ev:
+            ev.set()
+
+    # -- worker messages (event loop) ----------------------------------------
+    def _on_msg(self, w: _Worker, msg: dict) -> None:
+        op = msg["op"]
+        if op == "ack":
+            w.free_slots.append(msg["slot"])
+            self._wake_slot_waiter(w)
+        elif op == "results":
+            self._scatter_results(w, msg)
+        elif op == "died":
+            log.error("worker %d died: %s", w.wid, msg.get("error"))
+            err = RuntimeError(f"worker {w.wid} died: {msg.get('error')}")
+            for pb in w.pending:
+                if not pb.future.done():
+                    pb.future.set_exception(err)
+            w.pending.clear()
+            if self._active is w:
+                self._active = None
+            self._wake_slot_waiter(w)
+            w.close()
+
+    def _scatter_results(self, w: _Worker, msg: dict) -> None:
+        import jax
+
+        treedef = pickle.loads(msg["treedef"])
+        shm = shared_memory.SharedMemory(name=msg["shm"])
+        try:
+            leaves = []
+            for shape, dtype, offb in msg["shapes"]:
+                n = int(np.prod(shape))
+                arr = np.frombuffer(shm.buf, dtype=np.dtype(dtype), count=n,
+                                    offset=offb).reshape(shape).copy()
+                leaves.append(arr)
+        finally:
+            shm.close()
+        self.stats["epochs"] += 1
+        self.stats["read_s_total"] += msg.get("read_s", 0.0)
+        for pb in w.pending:
+            if pb.future.done():
+                continue
+            rows = [l[pb.off:pb.off + pb.bucket[0]] for l in leaves]
+            pb.future.set_result(jax.tree_util.tree_unflatten(treedef, rows))
+        w.pending.clear()
+        try:
+            w.conn.send({"op": "bye"})
+        except (BrokenPipeError, OSError):
+            pass
+        w.close()
+
+    # -- admin ---------------------------------------------------------------
+    def describe(self) -> dict:
+        return {
+            "model": self.model.name,
+            "family": self.mcfg.family,
+            "mode": "recycle",
+            "dtype": self.mcfg.dtype,
+            "workers_alive": len([w for w in self._workers if w.proc.is_alive()]),
+            "warm": len(self._warm),
+            "epoch_images": self.cap_rows,
+            "epoch_ms": self.mcfg.relay_epoch_ms,
+            "buckets": [list(b) for b in self.model.buckets()],
+            "stats": dict(self.stats),
+        }
+
+    async def stop(self) -> None:
+        for w in self._workers:
+            if w.proc.is_alive() and not w.retired and w.pending:
+                self._retire(w)
+        await asyncio.sleep(0.05)
+        for w in self._workers:
+            w.close()
+
+
+class _WorkerGone(Exception):
+    """Active worker retired/died while a batch waited for a slot."""
+
+
+def _backend_live() -> bool:
+    """True if this process already initialized an XLA backend."""
+    try:
+        from jax._src import xla_bridge  # noqa: PLC0415 — no public probe exists
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False

@@ -31,6 +31,14 @@ HostBatch = Any
 Outputs = Any
 
 
+def _stack_pad(arrs: list[np.ndarray], b: int) -> np.ndarray:
+    out = np.stack(arrs, axis=0)
+    if out.shape[0] < b:
+        pad = np.zeros((b - out.shape[0],) + out.shape[1:], dtype=out.dtype)
+        out = np.concatenate([out, pad], axis=0)
+    return out
+
+
 class ServingModel(abc.ABC):
     """One deployable model family instance."""
 
@@ -111,15 +119,16 @@ class ServingModel(abc.ABC):
     def assemble(self, items: list[Any], bucket: tuple) -> HostBatch:
         """Stack decoded items into one padded host batch for `bucket`.
 
-        Default: items are single np arrays; stack along axis 0 and pad the
-        batch dim with zeros up to bucket[0].
+        Default: items are single np arrays or tuples of np arrays (e.g. YUV
+        planes); each component is stacked along axis 0 and zero-padded on the
+        batch dim up to bucket[0].
         """
         b = bucket[0]
-        arr = np.stack(items, axis=0)
-        if arr.shape[0] < b:
-            pad = np.zeros((b - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype)
-            arr = np.concatenate([arr, pad], axis=0)
-        return arr
+        if isinstance(items[0], tuple):
+            return tuple(
+                _stack_pad([it[k] for it in items], b) for k in range(len(items[0]))
+            )
+        return _stack_pad(items, b)
 
     # -- parallelism --------------------------------------------------------
     def partition_rules(self) -> list[tuple[str, P]]:

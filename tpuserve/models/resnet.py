@@ -94,17 +94,38 @@ class ResNet50Serving(ServingModel):
     def input_signature(self, bucket: tuple) -> Any:
         (b,) = bucket
         w = self.cfg.wire_size
+        if self.cfg.wire_format == "yuv420":
+            h = w // 2
+            return (
+                jax.ShapeDtypeStruct((b, w, w), jnp.uint8),
+                jax.ShapeDtypeStruct((b, h, h), jnp.uint8),
+                jax.ShapeDtypeStruct((b, h, h), jnp.uint8),
+            )
         return jax.ShapeDtypeStruct((b, w, w, 3), jnp.uint8)
 
-    def forward(self, params: Any, batch: jax.Array) -> dict:
-        x = preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
+    def forward(self, params: Any, batch: Any) -> dict:
+        if self.cfg.wire_format == "yuv420":
+            y, u, v = batch
+            x = preproc.device_prepare_images_yuv420(
+                y, u, v, self.cfg.image_size, dtype=self.dtype)
+        else:
+            x = preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
         logits = self.module.apply(params, x)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         top_p, top_i = jax.lax.top_k(probs, self.TOP_K)
         return {"probs": top_p, "indices": top_i}
 
-    def host_decode(self, payload: bytes, content_type: str) -> np.ndarray:
+    def host_decode(self, payload: bytes, content_type: str) -> Any:
+        if self.cfg.wire_format == "yuv420":
+            return preproc.decode_image_yuv420(payload, content_type, self.cfg.wire_size)
         return preproc.decode_image(payload, content_type, edge=self.cfg.wire_size)
+
+    def canary_item(self) -> Any:
+        if self.cfg.wire_format == "yuv420":
+            w, h = self.cfg.wire_size, self.cfg.wire_size // 2
+            return (np.zeros((w, w), np.uint8), np.full((h, h), 128, np.uint8),
+                    np.full((h, h), 128, np.uint8))
+        return super().canary_item()
 
     def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
         probs = outputs["probs"][:n_valid]

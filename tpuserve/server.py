@@ -69,7 +69,16 @@ class ServerState:
             for mcfg in self.cfg.models:
                 t0 = time.perf_counter()
                 model = modelzoo.build(mcfg)
-                rt = build_runtime(model, pool=compile_pool)
+                if mcfg.session_mode == "recycle":
+                    # Deferred-readback worker pool (tpuserve.deferred): this
+                    # process never touches the accelerator; forked workers
+                    # own one PJRT session each.
+                    from tpuserve.deferred import DeferredPool
+
+                    rt = DeferredPool(mcfg, self.cfg.compilation_cache_dir, model)
+                    rt.prewarm()
+                else:
+                    rt = build_runtime(model, pool=compile_pool)
                 self.models[mcfg.name] = model
                 self.runtimes[mcfg.name] = rt
                 log.info("model %s ready in %.1fs: %s", mcfg.name, time.perf_counter() - t0, rt.describe())
@@ -78,7 +87,10 @@ class ServerState:
 
     async def start(self) -> None:
         for name, model in self.models.items():
-            b = ModelBatcher(model, self.runtimes[name], self.metrics, self.pool)
+            rt = self.runtimes[name]
+            if hasattr(rt, "enqueue"):  # DeferredPool: bind to the loop
+                await rt.start()
+            b = ModelBatcher(model, rt, self.metrics, self.pool)
             await b.start()
             self.batchers[name] = b
         if self.cfg.startup_canary:
@@ -99,6 +111,9 @@ class ServerState:
     async def stop(self) -> None:
         for b in self.batchers.values():
             await b.stop()
+        for rt in self.runtimes.values():
+            if hasattr(rt, "enqueue"):
+                await rt.stop()
         self.pool.shutdown(wait=False, cancel_futures=True)
 
 
