@@ -145,23 +145,3 @@ int jpegyuv_decode(const uint8_t *buf, long len,
     jpeg_destroy_decompress(&cinfo);
     return 0;
 }
-
-/* Batched variant: decode n same-sized JPEGs into contiguous plane batches.
- * offsets[i]/lengths[i] describe JPEG i inside buf. Returns the number
- * decoded OK; per-image status goes into status[i] (0 ok / negative). */
-int jpegyuv_decode_batch(const uint8_t *buf, const long *offsets,
-                         const long *lengths, int n,
-                         uint8_t *y, uint8_t *u, uint8_t *v,
-                         int edge, int *status) {
-    int half = edge / 2;
-    size_t ysz = (size_t)edge * edge, csz = (size_t)half * half;
-    int ok = 0, i;
-    for (i = 0; i < n; i++) {
-        status[i] = jpegyuv_decode(buf + offsets[i], lengths[i],
-                                   y + (size_t)i * ysz,
-                                   u + (size_t)i * csz,
-                                   v + (size_t)i * csz, edge);
-        if (status[i] == 0) ok++;
-    }
-    return ok;
-}

@@ -209,6 +209,8 @@ class ModelRuntime:
 
     # -- info ---------------------------------------------------------------
     def describe(self) -> dict:
+        from tpuserve.utils.trees import tree_summary
+
         return {
             "model": self.model.name,
             "family": self.cfg.family,
@@ -217,6 +219,7 @@ class ModelRuntime:
             "replicas": len(self.meshes),
             "mesh_shape": dict(self.meshes[0].shape),
             "buckets": [list(b) for b in sorted(self.executables)],
+            "params": tree_summary(self.params_per_mesh[0]) if self.params_per_mesh else {},
         }
 
 
