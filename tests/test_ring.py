@@ -1,0 +1,85 @@
+"""Ring attention (tpuserve.ops.ring_attention) on the 8-fake-device mesh.
+
+Correctness bar: the sequence-parallel ring result must match dense
+single-device attention to f32 tolerance, with and without key-padding masks,
+and under combined dp+sp sharding (SURVEY.md §5 long-context).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuserve.ops import dense_attention, ring_attention
+from tpuserve.parallel import make_mesh
+from tpuserve.parallel.mesh import MeshPlan
+
+
+def _qkv(rng, b=2, s=16, h=4, d=8):
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.fixture
+def mesh():
+    # 8 devices -> dp=2, tp=2, sp=2: exercises seq rotation with other axes live.
+    return make_mesh(MeshPlan(tp=2, sp=2))
+
+
+def test_matches_dense(mesh, rng):
+    q, k, v = _qkv(rng)
+    out = ring_attention(q, k, v, mesh)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_matches_dense_with_key_padding(mesh, rng):
+    q, k, v = _qkv(rng)
+    pad = np.zeros((2, 16), np.float32)
+    pad[:, 12:] = -1e9  # mask the tail keys
+    out = ring_attention(q, k, v, mesh, key_padding=jnp.asarray(pad))
+    bias = jnp.asarray(pad)[:, None, None, :]
+    ref = dense_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_dp_plus_sp_spec(mesh, rng):
+    q, k, v = _qkv(rng)
+    spec = P("data", "seq", None, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh, spec=spec))(q, k, v)
+    ref = dense_attention(*_qkv(np.random.default_rng(0)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_sequence_actually_sharded(mesh, rng):
+    """The output really is seq-sharded (not silently gathered)."""
+    q, k, v = _qkv(rng)
+    spec = P("data", "seq", None, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh, spec=spec))(q, k, v)
+    assert out.sharding.spec == spec
+    shard_shapes = {s.data.shape for s in out.addressable_shards}
+    assert shard_shapes == {(1, 8, 4, 8)}  # b/dp=1, s/sp=8
+
+
+def test_bf16_inputs(mesh, rng):
+    q, k, v = _qkv(rng)
+    out = ring_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                         v.astype(jnp.bfloat16), mesh)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=5e-2)
+
+
+def test_train_step_with_ring_attention():
+    from tpuserve.train import dryrun
+
+    loss = dryrun(jax.devices(), steps=1)  # 8 devs -> sp=2 -> ring path
+    assert np.isfinite(loss)

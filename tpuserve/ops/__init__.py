@@ -1,0 +1,10 @@
+"""Device-level ops that go beyond plain jnp calls (SURVEY.md §5 long-context).
+
+- ``ring_attention`` — sequence-parallel blockwise attention: the sequence is
+  sharded over a mesh axis and K/V blocks rotate around the ICI ring via
+  ``jax.lax.ppermute`` while each device accumulates its queries' output with
+  an online (streaming) softmax. Memory per device is O(seq/devices), enabling
+  contexts far beyond one chip's HBM.
+"""
+
+from tpuserve.ops.ring_attention import dense_attention, ring_attention  # noqa: F401

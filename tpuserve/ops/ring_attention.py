@@ -1,0 +1,122 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+Long-context design (SURVEY.md §5 "Long-context/sequence parallelism"): for
+sequences that exceed one device's HBM — or whose O(seq^2) score matrix does —
+the sequence dim is sharded over the mesh's ``"seq"`` axis. Each device holds
+one block of Q/K/V. K/V blocks then rotate around the ring with
+``jax.lax.ppermute`` (nearest-neighbor ICI traffic, no all-gather), and every
+device folds each visiting block into its queries' result with an online
+softmax (running max ``m``, normalizer ``l``, weighted accumulator ``acc`` —
+the same recurrence flash/blockwise attention uses). After ``seq_devices``
+steps every query has attended to the full sequence while no device ever
+materialized more than a (q_local, k_local) score tile.
+
+The rotation runs inside ``lax.scan`` so XLA emits one compiled loop body;
+``ppermute`` of the *next* block is issued before the current block's math,
+letting the compiler overlap ICI transfer with MXU compute.
+
+Layouts: (batch, seq, heads, head_dim) throughout — matching
+``nn.MultiHeadDotProductAttention`` — with seq sharded and heads replicated.
+Bidirectional (encoder) attention; an additive bias (e.g. padding mask) can be
+passed sharded the same way as K.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    bias: jax.Array | None = None) -> jax.Array:
+    """Reference single-device attention, (B, S, H, D) layout.
+
+    ``bias`` is additive on the scores, shaped (B, 1|H, S_q, S_k).
+    """
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_body(q, k, v, kbias, axis_name: str, vary_axes: tuple = ()):
+    """Per-device ring loop: local Q stays, K/V (+ per-key bias) rotate."""
+    n = jax.lax.psum(1, axis_name)
+    scale = q.shape[-1] ** -0.5
+    b, sq, h, d = q.shape
+
+    # Online-softmax state, (B, H, Sq) / (B, Sq, H, D). pvary marks the
+    # constants as varying over every sharded axis so scan carry types match
+    # the loop outputs (which inherit q/k/v's varying axes).
+    vary = vary_axes or (axis_name,)
+    m0 = jax.lax.pcast(jnp.full((b, h, sq), -jnp.inf, jnp.float32), vary, to="varying")
+    l0 = jax.lax.pcast(jnp.zeros((b, h, sq), jnp.float32), vary, to="varying")
+    acc0 = jax.lax.pcast(jnp.zeros((b, sq, h, d), jnp.float32), vary, to="varying")
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_blk, v_blk, bias_blk, m, l, acc = carry
+        # Issue the rotation first so ICI overlaps the tile's compute.
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        bias_nxt = jax.lax.ppermute(bias_blk, axis_name, perm)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        s = s + bias_blk[:, None, None, :]  # (B, Sk) per-key additive bias
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)  # rescale of previous state
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, v_blk.astype(jnp.float32))
+        return (k_nxt, v_nxt, bias_nxt, m_new, l, acc), None
+
+    (_, _, _, _, l, acc), _ = jax.lax.scan(
+        step, (k, v, kbias, m0, l0, acc0), None, length=n)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: Mesh, axis_name: str = "seq",
+                   key_padding: jax.Array | None = None,
+                   spec: P | None = None) -> jax.Array:
+    """Sequence-parallel attention; call inside or outside jit.
+
+    Args:
+      q, k, v: (batch, seq, heads, head_dim), seq sharded on ``axis_name``
+        (global arrays; shard_map slices them).
+      mesh: the device mesh containing ``axis_name``.
+      key_padding: optional (batch, seq) additive bias per key position
+        (0 = attend, -inf/-1e9 = masked), sharded like K's seq dim.
+      spec: optional full PartitionSpec for q/k/v, e.g.
+        ``P("data", "seq", "model", None)`` to keep batch data-parallel and
+        heads tensor-parallel through the ring (position 1 must be
+        ``axis_name``). Default shards only the seq dim.
+
+    Returns (batch, seq, heads, head_dim), sharded like q.
+    """
+    if key_padding is None:
+        key_padding = jnp.zeros(k.shape[:2], jnp.float32)
+    qkv_spec = spec if spec is not None else P(None, axis_name, None, None)
+    if qkv_spec[1] != axis_name:
+        raise ValueError(f"spec {qkv_spec} must put {axis_name!r} on the seq dim")
+    bias_spec = P(qkv_spec[0], axis_name)
+    vary_axes = []
+    for entry in qkv_spec:
+        if entry is None:
+            continue
+        vary_axes.extend(entry if isinstance(entry, (tuple, list)) else [entry])
+    fn = shard_map(
+        partial(_ring_body, axis_name=axis_name, vary_axes=tuple(vary_axes)),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
+        out_specs=qkv_spec,
+    )
+    return fn(q, k, v, key_padding)

@@ -8,10 +8,11 @@ sharding layout (SURVEY.md §5 "Distributed communication backend").
 
 Submodules:
 
-- ``mesh``       — mesh construction (dp/tp axes, multi-host seam)
+- ``mesh``       — mesh construction (dp/tp/sp axes, multi-host seam)
 - ``partition``  — regex partition rules -> PartitionSpec pytrees
-- ``ring``       — ring attention / sequence parallelism (ops-level impl in
-                   tpuserve.ops.ring_attention; this module wires meshes)
+
+Sequence parallelism for long contexts lives at the op level:
+``tpuserve.ops.ring_attention`` (shard_map + ppermute over the "seq" axis).
 """
 
 from tpuserve.parallel.mesh import (  # noqa: F401

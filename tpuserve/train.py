@@ -8,11 +8,12 @@ DP (batch on "data"), TP (attention/MLP kernels on "model"), and SP
 collectives (psum for grads across data, all-gather/reduce-scatter around TP
 matmuls) over ICI.
 
-The model is a compact pre-LN transformer encoder LM — the same block
-structure tpuserve.models.bert serves — trained with masked-token
+The model is a compact pre-LN transformer encoder LM trained with masked-token
 cross-entropy via optax.adamw. Everything is shape-static and scans-free at
 this size; jax.checkpoint on the block stack trades FLOPs for HBM when
-layers/seq grow.
+layers/seq grow. With ``TrainConfig.ring_attention=True`` the blocks use
+``tpuserve.ops.ring_attention`` over the mesh's "seq" axis instead of dense
+attention, so the dry run exercises real sequence parallelism.
 """
 
 from __future__ import annotations
@@ -43,18 +44,42 @@ class TrainConfig:
     max_seq: int = 32
     lr: float = 1e-3
     remat: bool = False
+    # Sequence-parallel attention: rotate K/V over the mesh "seq" axis via
+    # tpuserve.ops.ring_attention instead of dense attention.
+    ring_attention: bool = False
 
 
 class Block(nn.Module):
     cfg: TrainConfig
     dtype: Any = jnp.float32
+    mesh: Any = None  # required when cfg.ring_attention
 
     @nn.compact
     def __call__(self, x):
         c = self.cfg
+        attention_fn = nn.dot_product_attention
+        if c.ring_attention:
+            from tpuserve.ops import ring_attention
+
+            if self.mesh is None:
+                raise ValueError("TrainConfig.ring_attention=True requires "
+                                 "passing mesh= to the module")
+            # Keep heads tensor-parallel through the ring when tp divides them;
+            # otherwise replicate heads (still seq- and data-parallel).
+            head_axis = "model" if c.n_heads % self.mesh.shape["model"] == 0 else None
+            spec = P("data", "seq", head_axis, None)
+
+            def attention_fn(query, key, value, mask=None, **_kw):  # noqa: ANN001
+                if mask is not None:
+                    raise NotImplementedError(
+                        "ring-attention train path takes no attention mask; "
+                        "pass padding via loss masking instead")
+                return ring_attention(query, key, value, self.mesh, spec=spec)
+
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(num_heads=c.n_heads, dtype=self.dtype,
-                                            deterministic=True, name="attn")(h)
+                                            deterministic=True, name="attn",
+                                            attention_fn=attention_fn)(h)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         h = nn.Dense(c.d_ff, dtype=self.dtype, name="up")(h)
@@ -66,6 +91,7 @@ class Block(nn.Module):
 class TransformerLM(nn.Module):
     cfg: TrainConfig
     dtype: Any = jnp.float32
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, tokens):
@@ -77,7 +103,7 @@ class TransformerLM(nn.Module):
         if c.remat:
             block = nn.remat(Block)
         for i in range(c.n_layers):
-            x = block(c, dtype=self.dtype, name=f"block{i}")(x)
+            x = block(c, dtype=self.dtype, mesh=self.mesh, name=f"block{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         return nn.Dense(c.vocab, dtype=jnp.float32, name="lm_head")(x)
 
@@ -97,9 +123,11 @@ TRAIN_PARTITION_RULES: list[tuple[str, P]] = [
 
 def make_train_state(mesh: Mesh, cfg: TrainConfig, rng: jax.Array | None = None):
     """Init params + opt state, sharded by the TP rules over `mesh`."""
-    model = TransformerLM(cfg)
+    model = TransformerLM(cfg, mesh=mesh)
     rng = rng if rng is not None else jax.random.key(0)
-    tokens = jnp.zeros((1, cfg.max_seq), jnp.int32)
+    # Init batch must divide the data axis: ring attention shard_maps the
+    # activations over ("data", "seq") even at init time.
+    tokens = jnp.zeros((mesh.shape["data"], cfg.max_seq), jnp.int32)
     params = model.init(rng, tokens)["params"]
 
     specs = match_partition_rules(TRAIN_PARTITION_RULES, params)
@@ -157,10 +185,16 @@ def mesh_plan_for(n_devices: int) -> MeshPlan:
 
 
 def dryrun(devices: list, steps: int = 1) -> float:
-    """One (or more) real sharded train step(s) on the given devices."""
+    """One (or more) real sharded train step(s) on the given devices.
+
+    When the mesh has a real "seq" axis (sp > 1), attention runs through
+    tpuserve.ops.ring_attention so the dry run exercises genuine sequence
+    parallelism (K/V ppermute around the ring), alongside DP and TP.
+    """
     n = len(devices)
-    mesh = make_mesh(mesh_plan_for(n), devices=devices)
-    cfg = TrainConfig()
+    plan = mesh_plan_for(n)
+    mesh = make_mesh(plan, devices=devices)
+    cfg = TrainConfig(ring_attention=plan.sp > 1)
     model, params, tx, opt_state, shardings = make_train_state(mesh, cfg)
     step, _ = make_train_step(model, tx, mesh, shardings)
     batch_size = max(4, 2 * mesh.shape["data"])
