@@ -32,6 +32,7 @@
 struct jy_err {
     struct jpeg_error_mgr mgr;
     jmp_buf jb;
+    int corrupt; /* count of corrupt-data warnings (e.g. truncated stream) */
 };
 
 static void jy_error_exit(j_common_ptr cinfo) {
@@ -40,7 +41,11 @@ static void jy_error_exit(j_common_ptr cinfo) {
 }
 
 static void jy_emit_message(j_common_ptr cinfo, int msg_level) {
-    (void)cinfo; (void)msg_level; /* quiet */
+    /* libjpeg "recovers" from truncated/corrupt streams by synthesizing
+     * data and emitting a level -1 warning; a serving wire must reject
+     * such input instead of silently returning half-garbage planes. */
+    if (msg_level == -1)
+        ((struct jy_err *)cinfo->err)->corrupt++;
 }
 
 int jpegyuv_probe(const uint8_t *buf, long len, int *w, int *h, int *subsamp) {
@@ -50,6 +55,7 @@ int jpegyuv_probe(const uint8_t *buf, long len, int *w, int *h, int *subsamp) {
     cinfo.err = jpeg_std_error(&jerr.mgr);
     jerr.mgr.error_exit = jy_error_exit;
     jerr.mgr.emit_message = jy_emit_message;
+    jerr.corrupt = 0;
     if (setjmp(jerr.jb)) {
         jpeg_destroy_decompress(&cinfo);
         return -1;
@@ -88,6 +94,7 @@ int jpegyuv_decode(const uint8_t *buf, long len,
     cinfo.err = jpeg_std_error(&jerr.mgr);
     jerr.mgr.error_exit = jy_error_exit;
     jerr.mgr.emit_message = jy_emit_message;
+    jerr.corrupt = 0;
     if (setjmp(jerr.jb)) {
         jpeg_destroy_decompress(&cinfo);
         return -1;
@@ -143,5 +150,5 @@ int jpegyuv_decode(const uint8_t *buf, long len,
 
     jpeg_finish_decompress(&cinfo);
     jpeg_destroy_decompress(&cinfo);
-    return 0;
+    return jerr.corrupt ? -6 : 0; /* truncated/corrupt stream: reject */
 }

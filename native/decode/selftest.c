@@ -1,0 +1,66 @@
+/* Standalone harness for the jpegyuv shim — built with ASan in CI
+ * (SURVEY.md §5 race detection/sanitizers; the Python test suite covers
+ * functional parity, this covers memory safety without Python in the way).
+ *
+ * Usage: selftest <file.jpg> <edge>
+ * Exit 0 on successful decode + plausible plane stats; nonzero otherwise.
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+
+extern int jpegyuv_probe(const uint8_t *buf, long len, int *w, int *h, int *subsamp);
+extern int jpegyuv_decode(const uint8_t *buf, long len,
+                          uint8_t *y, uint8_t *u, uint8_t *v, int edge);
+
+int main(int argc, char **argv) {
+    if (argc != 3) { fprintf(stderr, "usage: selftest f.jpg edge\n"); return 2; }
+    int edge = atoi(argv[2]), half = edge / 2;
+    FILE *f = fopen(argv[1], "rb");
+    if (!f) { perror("open"); return 2; }
+    fseek(f, 0, SEEK_END);
+    long len = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    uint8_t *buf = malloc(len);
+    if (fread(buf, 1, len, f) != (size_t)len) { fclose(f); return 2; }
+    fclose(f);
+
+    int w, h, sub, rc, fail = 0;
+    uint8_t *y = malloc((size_t)edge * edge);
+    uint8_t *u = malloc((size_t)half * half);
+    uint8_t *v = malloc((size_t)half * half);
+
+    if (jpegyuv_probe(buf, len, &w, &h, &sub) != 0) {
+        fprintf(stderr, "probe failed\n");
+        fail = 1;
+    } else {
+        printf("probe: %dx%d subsamp=%d\n", w, h, sub);
+        rc = jpegyuv_decode(buf, len, y, u, v, edge);
+        if (rc != 0) {
+            fprintf(stderr, "decode rc=%d\n", rc);
+            fail = 1;
+        } else {
+            long ysum = 0;
+            for (long i = 0; i < (long)edge * edge; i++) ysum += y[i];
+            printf("decode ok, mean_y=%.1f\n", (double)ysum / (edge * edge));
+        }
+        /* Truncated input must be rejected (libjpeg pads it with fake EOI
+         * and a corrupt-data warning; the shim turns that into -6). */
+        if (jpegyuv_decode(buf, len / 2, y, u, v, edge) == 0) {
+            fprintf(stderr, "truncated input decoded?!\n");
+            fail = 1;
+        }
+        /* Garbage input likewise. */
+        {
+            uint8_t junk[64] = {0xff, 0xd8, 1, 2, 3};
+            if (jpegyuv_decode(junk, sizeof junk, y, u, v, edge) == 0) {
+                fprintf(stderr, "garbage decoded?!\n");
+                fail = 1;
+            }
+        }
+    }
+    free(y); free(u); free(v); free(buf);
+    if (!fail) printf("selftest ok\n");
+    return fail;
+}

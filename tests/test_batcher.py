@@ -107,16 +107,40 @@ def test_fault_containment(rt_model):
 
 
 def test_load_shedding(rt_model):
+    """Real shedding behavior: with the deadline far out and the bucket not
+    full, pending requests pile up and the (max_queue+1)th submit 429s."""
     async def go():
-        b, _ = make_batcher(rt_model, max_queue=2, deadline_ms=10_000.0)
-        # don't start the group loops: nothing drains the queue
+        b, metrics = make_batcher(rt_model, max_queue=2, deadline_ms=10_000.0)
         await b.start()
-        b._queues[None] = asyncio.Queue()  # pre-create so no task spawns
-        b.submit(item())
-        b.submit(item())
+        f1 = b.submit(item())
+        f2 = b.submit(item())
+        await asyncio.sleep(0.05)  # group loop runs; batch (max 4) not full
         with pytest.raises(QueueFull):
             b.submit(item())
+        assert metrics.counter("shed_total{model=toy}").value == 1
+        f1.cancel(), f2.cancel()
         await b.stop()
+
+    run(go())
+
+
+def test_submit_before_start_raises(rt_model):
+    b, _ = make_batcher(rt_model)
+    with pytest.raises(RuntimeError, match="not started"):
+        b.submit(item())
+
+
+def test_stop_fails_queued_futures(rt_model):
+    """Requests still queued at stop() resolve with an error, never hang
+    (ADVICE r1: stop() cleared queues without failing futures)."""
+    async def go():
+        b, _ = make_batcher(rt_model, max_queue=16, deadline_ms=10_000.0)
+        await b.start()
+        futs = [b.submit(item()) for _ in range(2)]
+        await b.stop()
+        for f in futs:
+            assert f.done()
+            assert isinstance(f.exception(), RuntimeError) or f.cancelled()
 
     run(go())
 

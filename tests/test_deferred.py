@@ -1,0 +1,176 @@
+"""Deferred-readback pool (tpuserve.deferred): epoch rotation, worker-death
+containment, clean shutdown, config guardrails, HTTP serving from a TOML
+config. SURVEY.md §4-1/§4-2; VERDICT.md r2 item 5.
+
+Workers run as spawned subprocesses on the CPU backend (the test process has
+a live XLA backend, so the pool picks spawn) — slow to fork (~seconds each),
+so the pool fixtures keep worker counts and epochs small.
+"""
+
+import asyncio
+import io
+
+import numpy as np
+import pytest
+
+from tpuserve.config import ModelConfig, load_config
+from tpuserve.deferred import DeferredPool
+from tpuserve.models import build
+
+
+def make_cfg(**over) -> ModelConfig:
+    base = dict(
+        name="toy", family="toy", batch_buckets=[2, 4], deadline_ms=10.0,
+        dtype="float32", num_classes=10, parallelism="single",
+        session_mode="recycle", relay_workers=2, relay_slots=2,
+        relay_epoch_images=8, relay_epoch_ms=400.0,
+        request_timeout_ms=30_000.0,
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def batch(n: int) -> np.ndarray:
+    return np.random.default_rng(n).integers(0, 255, (n, 8, 8, 3), dtype=np.uint8)
+
+
+@pytest.fixture(scope="module")
+def pool_env():
+    """One prewarmed 2-worker pool + its event loop, shared by the module
+    (spawn cost); tests that kill workers run last via ordering below."""
+    cfg = make_cfg()
+    model = build(cfg)
+    pool = DeferredPool(cfg, "", model)
+    pool.prewarm()
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(pool.start())
+    yield loop, pool
+    loop.run_until_complete(pool.stop())
+    loop.close()
+
+
+def test_timeout_floor_applied():
+    cfg = make_cfg(request_timeout_ms=100.0, relay_epoch_ms=200.0)
+    DeferredPool(cfg, "", build(cfg))
+    assert cfg.request_timeout_ms == pytest.approx(2 * 200.0 + 1000.0)
+
+
+def test_epoch_rotation_and_results(pool_env):
+    loop, pool = pool_env
+
+    async def go():
+        # 3 batches of 4 rows: rows 0-7 fill worker A's 8-row epoch budget;
+        # batch 3 forces rotation to worker B. All resolve with real results.
+        futs = [await pool.enqueue((4,), batch(4)) for _ in range(3)]
+        outs = await asyncio.wait_for(asyncio.gather(*futs), timeout=30)
+        for out in outs:
+            assert out["probs"].shape == (4, 3)
+            assert np.all(out["probs"][:, 0] >= out["probs"][:, 1])
+        assert pool.stats["epochs"] >= 1
+        assert pool.stats["rows_total"] == 12
+
+    loop.run_until_complete(go())
+
+
+def test_epoch_deadline_fires_without_fill(pool_env):
+    loop, pool = pool_env
+
+    async def go():
+        # One small batch, epoch far from full: the relay_epoch_ms timer must
+        # retire the worker and resolve the future anyway.
+        fut = await pool.enqueue((2,), batch(2))
+        out = await asyncio.wait_for(fut, timeout=30)
+        assert out["indices"].shape == (2, 3)
+
+    loop.run_until_complete(go())
+
+
+def test_worker_death_contained(pool_env):
+    loop, pool = pool_env
+
+    async def go():
+        fut = await pool.enqueue((2,), batch(2))
+        w = pool._active
+        assert w is not None
+        w.proc.kill()  # simulate OOM/preemption mid-epoch
+        with pytest.raises(RuntimeError, match="died"):
+            await asyncio.wait_for(fut, timeout=30)
+        # The pool recovers: the next enqueue lands on a fresh worker.
+        fut2 = await pool.enqueue((2,), batch(2))
+        out = await asyncio.wait_for(fut2, timeout=120)
+        assert out["indices"].shape == (2, 3)
+
+    loop.run_until_complete(go())
+
+
+def test_clean_shutdown_resolves_pending():
+    """stop() must wait for the epoch readback: pending futures resolve with
+    results, not 'worker died' (the r2 judge-observed 50 ms strand)."""
+    cfg = make_cfg(relay_workers=2, relay_epoch_ms=5_000.0)
+    pool = DeferredPool(cfg, "", build(cfg))
+    pool.prewarm()
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        await pool.start()
+        fut = await pool.enqueue((2,), batch(2))
+        await pool.stop()  # epoch nowhere near done: stop retires + waits
+        assert fut.done() and fut.exception() is None
+        out = fut.result()
+        assert out["indices"].shape == (2, 3)
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_recycle_serves_over_http_from_toml(tmp_path):
+    """Recycle mode is launchable from a TOML config and serves end-to-end."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpuserve.server import ServerState, make_app
+
+    toml = tmp_path / "recycle.toml"
+    toml.write_text(
+        """
+        decode_threads = 2
+        startup_canary = false
+
+        [[model]]
+        name = "toy"
+        family = "toy"
+        batch_buckets = [2]
+        deadline_ms = 5.0
+        dtype = "float32"
+        num_classes = 10
+        parallelism = "single"
+        session_mode = "recycle"
+        relay_workers = 2
+        relay_slots = 2
+        relay_epoch_images = 4
+        relay_epoch_ms = 300.0
+        """
+    )
+    cfg = load_config(str(toml))
+    assert cfg.models[0].session_mode == "recycle"
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            buf = io.BytesIO()
+            np.save(buf, batch(1)[0])
+            resp = await client.post(
+                "/v1/models/toy:predict", data=buf.getvalue(),
+                headers={"Content-Type": "application/x-npy"})
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert len(body["top_k"]) == 3
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()

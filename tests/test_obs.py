@@ -55,3 +55,15 @@ def test_percentile_exact():
     assert percentile([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 0.5) == 5
     assert percentile([], 0.5) == 0.0
     assert percentile([42], 0.99) == 42
+
+
+def test_prometheus_label_values_escaped():
+    """Quotes/backslashes/newlines in label values must not corrupt the
+    exposition format (ADVICE r1, unfixed through r2)."""
+    m = Metrics()
+    m.counter('requests_total{model=we"ird\\name}').inc()
+    text = m.render_prometheus()
+    assert 'model="we\\"ird\\\\name"' in text
+    # Still exactly one sample line for the counter
+    assert sum(1 for line in text.splitlines()
+               if line.startswith("requests_total{")) == 1

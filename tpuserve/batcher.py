@@ -82,7 +82,7 @@ class ModelBatcher:
         self._inflight = asyncio.Semaphore(max(1, self.cfg.max_inflight))
 
     async def stop(self) -> None:
-        """Cancel accumulation, then drain in-flight batches to completion."""
+        """Cancel accumulation, fail queued requests, drain in-flight batches."""
         self._running = False
         for t in self._tasks:
             t.cancel()
@@ -92,6 +92,16 @@ class ModelBatcher:
             except asyncio.CancelledError:
                 pass
         self._tasks.clear()
+        # Requests still queued (never dispatched) must not hang their
+        # clients: fail them explicitly (ADVICE r1: stop() cleared queues
+        # without resolving futures).
+        err = RuntimeError(f"server shutting down; {self.model.name} not served")
+        for q in self._queues.values():
+            while not q.empty():
+                req = q.get_nowait()
+                self._pending -= 1
+                if not req.future.done():
+                    req.future.set_exception(err)
         self._queues.clear()
         if self._dispatch_tasks:
             await asyncio.gather(*self._dispatch_tasks, return_exceptions=True)
@@ -99,6 +109,8 @@ class ModelBatcher:
     # -- submission (event loop) --------------------------------------------
     def submit(self, item: Any, group: Hashable = None) -> asyncio.Future:
         """Enqueue one decoded request; returns a Future of its result."""
+        if not self._running or self._inflight is None:
+            raise RuntimeError(f"batcher for {self.model.name} not started")
         if self._pending >= self.cfg.max_queue:
             self.metrics.counter(f"shed_total{{model={self.model.name}}}").inc()
             raise QueueFull(self.model.name)
@@ -121,18 +133,29 @@ class ModelBatcher:
         while True:
             req = await q.get()
             batch = [req]
-            flush_at = req.enqueued_at + deadline_s
-            while len(batch) < max_bucket:
-                timeout = flush_at - time.perf_counter()
-                if timeout <= 0:
-                    break
-                try:
-                    batch.append(await asyncio.wait_for(q.get(), timeout))
-                except asyncio.TimeoutError:
-                    break
-            # Backpressure: the semaphore bounds in-flight device batches; the
-            # group task itself waits here, which is what pipelines dispatch.
-            await self._inflight.acquire()
+            try:
+                flush_at = req.enqueued_at + deadline_s
+                while len(batch) < max_bucket:
+                    timeout = flush_at - time.perf_counter()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(await asyncio.wait_for(q.get(), timeout))
+                    except asyncio.TimeoutError:
+                        break
+                # Backpressure: the semaphore bounds in-flight device batches;
+                # the group task itself waits here, which pipelines dispatch.
+                await self._inflight.acquire()
+            except asyncio.CancelledError:
+                # stop() cancelled us mid-accumulation: requests already
+                # pulled off the queue must fail, not hang their clients.
+                err = RuntimeError(
+                    f"server shutting down; {self.model.name} not served")
+                self._pending -= len(batch)
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(err)
+                raise
             # Adaptive drain: anything that queued while we waited (deadline or
             # a free slot) would only wait longer — fold it into this batch up
             # to the largest bucket. This makes batch size track device speed

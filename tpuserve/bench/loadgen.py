@@ -1,16 +1,19 @@
 """Asyncio HTTP load generator (SURVEY.md §2 C11).
 
-Two modes (VERDICT.md r1 item 3):
+Two modes:
 
-- **Closed loop** (``run_load``): C workers each keep exactly one request in
-  flight. Measures peak sustainable throughput; its p50 is queueing delay by
-  Little's law, NOT server latency.
+- **Closed loop** (``run_load``): ``concurrency`` workers each keep exactly
+  one request in flight. Measures peak sustainable throughput; its p50 is
+  queueing delay by Little's law, NOT server latency.
 - **Open loop** (``run_load_open``): requests are issued on a fixed-rate
   clock regardless of completions, like independent clients. Latency
-  percentiles at a stated offered rate are the honest latency metric.
+  percentiles at a stated offered rate are the honest latency metric
+  (BASELINE.md's ≤15 ms p50 target is defined this way).
 
-Both record only requests that *complete inside* the measurement window and
-divide by the actual window, so stragglers can't inflate throughput.
+Window accounting, both modes: a request is recorded only if it *completes*
+inside the measurement window ``[warmup, warmup + duration)``; throughput
+divides by the actual window length. In-flight stragglers at window end are
+counted separately (``n_late``) and never inflate throughput.
 """
 
 from __future__ import annotations
@@ -28,9 +31,12 @@ from tpuserve.obs import percentile
 
 @dataclass
 class LoadResult:
+    mode: str = "closed"
     n_ok: int = 0
     n_err: int = 0
-    duration_s: float = 0.0
+    n_late: int = 0  # completed after the window closed (excluded above)
+    duration_s: float = 0.0  # actual measurement window
+    offered_rate: float = 0.0  # open loop only: requests/s issued
     latencies_ms: list[float] = field(default_factory=list)
 
     @property
@@ -38,14 +44,20 @@ class LoadResult:
         return self.n_ok / self.duration_s if self.duration_s > 0 else 0.0
 
     def summary(self) -> dict:
-        return {
+        out = {
+            "mode": self.mode,
             "n_ok": self.n_ok,
             "n_err": self.n_err,
+            "n_late": self.n_late,
             "duration_s": round(self.duration_s, 3),
             "throughput_per_s": round(self.throughput, 1),
             "p50_ms": round(percentile(self.latencies_ms, 0.5), 3),
+            "p90_ms": round(percentile(self.latencies_ms, 0.9), 3),
             "p99_ms": round(percentile(self.latencies_ms, 0.99), 3),
         }
+        if self.mode == "open":
+            out["offered_rate_per_s"] = round(self.offered_rate, 1)
+        return out
 
 
 def synthetic_image_npy(edge: int = 256, seed: int = 0) -> bytes:
@@ -74,6 +86,21 @@ def synthetic_image_jpeg(edge: int = 256, seed: int = 0, quality: int = 85) -> b
     return buf.getvalue()
 
 
+def _record(result: LoadResult, ok: bool, t0: float, t1: float,
+            record_from: float, stop_at: float) -> None:
+    """Window-clamp one completion: only [record_from, stop_at) counts."""
+    if t1 < record_from:
+        return  # warmup
+    if t1 >= stop_at:
+        result.n_late += 1
+        return
+    if ok:
+        result.n_ok += 1
+        result.latencies_ms.append((t1 - t0) * 1e3)
+    else:
+        result.n_err += 1
+
+
 async def run_load(
     url: str,
     payload: bytes,
@@ -82,40 +109,93 @@ async def run_load(
     concurrency: int = 64,
     warmup_s: float = 2.0,
 ) -> LoadResult:
+    """Closed loop: `concurrency` workers, one request in flight each."""
     import aiohttp
 
-    result = LoadResult()
-    stop_at = 0.0
-    record_from = 0.0
+    result = LoadResult(mode="closed")
+    headers = {"Content-Type": content_type}
+    now = time.perf_counter()
+    record_from = now + warmup_s
+    stop_at = now + warmup_s + duration_s
 
     async def worker(session: aiohttp.ClientSession) -> None:
         while time.perf_counter() < stop_at:
             t0 = time.perf_counter()
             try:
-                async with session.post(
-                    url, data=payload, headers={"Content-Type": content_type}
-                ) as resp:
+                async with session.post(url, data=payload, headers=headers) as resp:
                     await resp.read()
                     ok = resp.status == 200
             except Exception:
                 ok = False
-            t1 = time.perf_counter()
-            if t1 < record_from:
-                continue
-            if ok:
-                result.n_ok += 1
-                result.latencies_ms.append((t1 - t0) * 1e3)
-            else:
-                result.n_err += 1
+            _record(result, ok, t0, time.perf_counter(), record_from, stop_at)
 
     conn = aiohttp.TCPConnector(limit=concurrency * 2)
     async with aiohttp.ClientSession(connector=conn) as session:
-        now = time.perf_counter()
-        record_from = now + warmup_s
-        stop_at = now + warmup_s + duration_s
         workers = [asyncio.ensure_future(worker(session)) for _ in range(concurrency)]
         await asyncio.gather(*workers)
-    result.duration_s = duration_s
+    result.duration_s = stop_at - record_from
+    return result
+
+
+async def run_load_open(
+    url: str,
+    payload: bytes,
+    content_type: str,
+    rate_per_s: float,
+    duration_s: float = 10.0,
+    warmup_s: float = 2.0,
+    max_inflight: int = 4096,
+) -> LoadResult:
+    """Open loop: issue at `rate_per_s` on a fixed clock, independent of
+    completions. If the server can't keep up, in-flight grows toward
+    ``max_inflight``; beyond it issues are dropped and counted as errors
+    (the alternative — silently pausing the clock — would turn the mode
+    closed-loop and overstate the server)."""
+    import aiohttp
+
+    if rate_per_s <= 0:
+        raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
+    result = LoadResult(mode="open", offered_rate=rate_per_s)
+    headers = {"Content-Type": content_type}
+    interval = 1.0 / rate_per_s
+    now = time.perf_counter()
+    record_from = now + warmup_s
+    stop_at = now + warmup_s + duration_s
+    inflight = 0
+    tasks: set[asyncio.Task] = set()
+
+    async def one(session: aiohttp.ClientSession) -> None:
+        nonlocal inflight
+        t0 = time.perf_counter()
+        try:
+            async with session.post(url, data=payload, headers=headers) as resp:
+                await resp.read()
+                ok = resp.status == 200
+        except Exception:
+            ok = False
+        finally:
+            inflight -= 1
+        _record(result, ok, t0, time.perf_counter(), record_from, stop_at)
+
+    conn = aiohttp.TCPConnector(limit=0)  # open loop: no client-side cap
+    async with aiohttp.ClientSession(connector=conn) as session:
+        next_issue = now
+        while next_issue < stop_at:
+            delay = next_issue - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if inflight >= max_inflight:
+                if time.perf_counter() >= record_from:
+                    result.n_err += 1  # shed at the client: server saturated
+            else:
+                inflight += 1
+                t = asyncio.ensure_future(one(session))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+            next_issue += interval
+        if tasks:  # stragglers: counted as n_late by _record
+            await asyncio.gather(*tasks, return_exceptions=True)
+    result.duration_s = stop_at - record_from
     return result
 
 
@@ -126,9 +206,13 @@ def run_loadgen_cli(args) -> int:
     else:
         payload = synthetic_image_npy()
     url = f"{args.url}/v1/models/{args.model}:{args.verb}"
-    result = asyncio.run(
-        run_load(url, payload, args.content_type, args.duration, args.concurrency,
-                 warmup_s=getattr(args, "warmup", 2.0))
-    )
+    warmup = getattr(args, "warmup", 2.0)
+    rate = getattr(args, "rate", None)
+    if rate:
+        result = asyncio.run(run_load_open(
+            url, payload, args.content_type, rate, args.duration, warmup))
+    else:
+        result = asyncio.run(run_load(
+            url, payload, args.content_type, args.duration, args.concurrency, warmup))
     print(json.dumps(result.summary()))
     return 0 if result.n_ok > 0 else 1

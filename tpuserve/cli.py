@@ -35,7 +35,10 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--verb", default="predict")
     p_bench.add_argument("--duration", type=float, default=10.0)
     p_bench.add_argument("--warmup", type=float, default=2.0)
-    p_bench.add_argument("--concurrency", type=int, default=64)
+    p_bench.add_argument("--concurrency", type=int, default=64,
+                         help="closed-loop workers (ignored with --rate)")
+    p_bench.add_argument("--rate", type=float, default=None,
+                         help="open-loop offered rate (req/s); switches to open-loop mode")
     p_bench.add_argument("--payload", default=None, help="file to POST; default synthetic image")
     p_bench.add_argument("--content-type", default="application/x-npy")
 

@@ -234,6 +234,11 @@ class Metrics:
         return out
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, newline."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _split(name: str) -> tuple[str, str]:
     """'lat{model=x,phase=y}' -> ('lat', 'model="x",phase="y",')."""
     if "{" not in name:
@@ -241,7 +246,7 @@ def _split(name: str) -> tuple[str, str]:
     base, _, rest = name.partition("{")
     rest = rest.rstrip("}")
     pairs = [p.split("=", 1) for p in rest.split(",") if p]
-    labels = ",".join(f'{k}="{v}"' for k, v in pairs)
+    labels = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
     return base, labels + "," if labels else ""
 
 

@@ -47,6 +47,9 @@ log = logging.getLogger("tpuserve.server")
 
 _VERBS = ("predict", "classify", "detect", "generate")
 
+# Typed aiohttp app key (string keys are deprecated).
+STATE_KEY: "web.AppKey[ServerState]" = web.AppKey("tpuserve_state", object)
+
 
 class ServerState:
     """Everything a running server owns."""
@@ -109,6 +112,12 @@ class ServerState:
                 self.canary_ok[name] = False
 
     async def stop(self) -> None:
+        # Deferred pools first retire their active workers (fast) so batcher
+        # dispatch tasks awaiting epoch readback resolve in readback time,
+        # not at the epoch deadline; then drain batchers, then stop pools.
+        for rt in self.runtimes.values():
+            if hasattr(rt, "retire_active"):
+                rt.retire_active()
         for b in self.batchers.values():
             await b.stop()
         for rt in self.runtimes.values():
@@ -120,7 +129,7 @@ class ServerState:
 # -- handlers ----------------------------------------------------------------
 
 async def handle_predict(request: web.Request) -> web.Response:
-    state: ServerState = request.app["state"]
+    state: ServerState = request.app[STATE_KEY]
     name = request.match_info["name"]
     model = state.models.get(name)
     if model is None:
@@ -143,6 +152,10 @@ async def handle_predict(request: web.Request) -> web.Response:
         fut = state.batchers[name].submit(item, group=model.group_key(item))
     except QueueFull:
         return _err(429, "queue full, retry later")
+    except RuntimeError as e:
+        # Batcher stopped/not started: requests racing shutdown get a clean
+        # retryable status instead of an unhandled 500.
+        return _err(503, f"server not accepting requests: {e}")
 
     try:
         timeout = mcfg.request_timeout_ms / 1e3
@@ -162,12 +175,12 @@ async def handle_predict(request: web.Request) -> web.Response:
 
 
 async def handle_models(request: web.Request) -> web.Response:
-    state: ServerState = request.app["state"]
+    state: ServerState = request.app[STATE_KEY]
     return web.json_response({n: rt.describe() for n, rt in state.runtimes.items()})
 
 
 async def handle_healthz(request: web.Request) -> web.Response:
-    state: ServerState = request.app["state"]
+    state: ServerState = request.app[STATE_KEY]
     ok = all(state.canary_ok.values()) if state.canary_ok else True
     return web.json_response(
         {"status": "ok" if ok else "degraded", "models": state.canary_ok},
@@ -176,17 +189,17 @@ async def handle_healthz(request: web.Request) -> web.Response:
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
-    state: ServerState = request.app["state"]
+    state: ServerState = request.app[STATE_KEY]
     return web.Response(text=state.metrics.render_prometheus(), content_type="text/plain")
 
 
 async def handle_stats(request: web.Request) -> web.Response:
-    state: ServerState = request.app["state"]
+    state: ServerState = request.app[STATE_KEY]
     return web.json_response(state.metrics.summary())
 
 
 async def handle_trace(request: web.Request) -> web.Response:
-    state: ServerState = request.app["state"]
+    state: ServerState = request.app[STATE_KEY]
     return web.Response(text=state.metrics.tracer.chrome_trace(), content_type="application/json")
 
 
@@ -221,7 +234,7 @@ def _err(status: int, message: str) -> web.Response:
 
 def make_app(state: ServerState) -> web.Application:
     app = web.Application(client_max_size=64 * 1024 * 1024)
-    app["state"] = state
+    app[STATE_KEY] = state
     for verb in _VERBS:
         app.router.add_post(f"/v1/models/{{name}}:{verb}", handle_predict)
     app.router.add_get("/v1/models", handle_models)
