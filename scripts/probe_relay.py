@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Measure the dev-TPU link physics that sizing decisions rest on.
+
+Each experiment runs in its OWN subprocess (fresh PJRT session): the first
+device->host read permanently changes a session's transfer mode, so H2D
+numbers must be taken before any D2H in that process.
+
+Run on the TPU box:  python scripts/probe_relay.py
+Emits one JSON object per experiment on stdout; a summary table at the end.
+Results are recorded in BASELINE.md ("Link physics" section).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+EXPERIMENTS = {
+    # H2D bandwidth in a virgin session (no D2H ever).
+    "h2d_virgin": """
+        import time, json
+        import numpy as np, jax
+        mb = 32
+        arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
+        out = []
+        for i in range(6):
+            t0 = time.perf_counter()
+            d = jax.device_put(arr)
+            jax.block_until_ready(d)
+            dt = time.perf_counter() - t0
+            out.append(round(mb / dt, 1))
+        print(json.dumps({"exp": "h2d_virgin", "mb": mb, "mbps_per_iter": out}))
+    """,
+    # Cost of D2H reads: the first (mode flip) and steady-state, small + large.
+    "d2h_costs": """
+        import time, json
+        import numpy as np, jax, jax.numpy as jnp
+        small = jax.device_put(np.zeros((64, 5), np.float32))
+        big = jax.device_put(np.zeros((8 << 20,), np.uint8))  # 8 MB
+        jax.block_until_ready((small, big))
+        reads = []
+        for i in range(5):
+            t0 = time.perf_counter(); np.asarray(small); reads.append(round((time.perf_counter()-t0)*1e3, 1))
+        t0 = time.perf_counter(); np.asarray(big); big_ms = round((time.perf_counter()-t0)*1e3, 1)
+        print(json.dumps({"exp": "d2h_costs", "small_1kb_ms": reads, "big_8mb_ms": big_ms}))
+    """,
+    # H2D bandwidth AFTER a D2H read (degraded mode?).
+    "h2d_after_d2h": """
+        import time, json
+        import numpy as np, jax
+        d = jax.device_put(np.zeros((64,), np.float32)); jax.block_until_ready(d)
+        np.asarray(d)  # flip the session
+        mb = 32
+        arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
+        out = []
+        for i in range(4):
+            t0 = time.perf_counter()
+            dd = jax.device_put(arr); jax.block_until_ready(dd)
+            out.append(round(mb / (time.perf_counter() - t0), 1))
+        print(json.dumps({"exp": "h2d_after_d2h", "mb": mb, "mbps_per_iter": out}))
+    """,
+    # ResNet-50 bucket-128 compute time with device-resident input vs with
+    # per-batch H2D (rgb8 224 wire) vs full run+fetch cycle.
+    "resnet_compute": """
+        import time, json
+        import numpy as np, jax
+        from tpuserve.config import ModelConfig
+        from tpuserve.models import build
+        from tpuserve.runtime import build_runtime
+        B = 128
+        cfg = ModelConfig(name="r", family="resnet50", batch_buckets=[B],
+                          parallelism="single", dtype="bfloat16", wire_size=224)
+        model = build(cfg)
+        rt = build_runtime(model)
+        batch = np.random.default_rng(0).integers(0, 255, (B, 224, 224, 3), np.uint8)
+        exe = rt.executables[(B,)][0]
+        dev = jax.device_put(batch, jax.tree_util.tree_leaves(exe.batch_sharding)[0])
+        jax.block_until_ready(dev)
+        # device-resident repeat: pure compute
+        outs = exe.compiled(rt.params_per_mesh[0], dev); jax.block_until_ready(outs)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            outs = exe.compiled(rt.params_per_mesh[0], dev)
+        jax.block_until_ready(outs)
+        compute_ms = (time.perf_counter() - t0) / 5 * 1e3
+        # h2d + dispatch (no fetch)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            o2 = rt.run((B,), batch)
+        jax.block_until_ready(o2)
+        h2d_compute_ms = (time.perf_counter() - t0) / 5 * 1e3
+        # full cycle with per-batch fetch
+        t0 = time.perf_counter()
+        for _ in range(5):
+            o3 = rt.fetch(rt.run((B,), batch))
+        cycle_ms = (time.perf_counter() - t0) / 5 * 1e3
+        print(json.dumps({"exp": "resnet_compute", "batch": B,
+                          "compute_ms": round(compute_ms, 1),
+                          "h2d_plus_compute_ms": round(h2d_compute_ms, 1),
+                          "full_cycle_ms": round(cycle_ms, 1),
+                          "imgs_per_s_cycle": round(B / (cycle_ms / 1e3), 1)}))
+    """,
+    # Host-side per-image costs on this box (1 CPU core).
+    "host_costs": """
+        import io, time, json
+        import numpy as np
+        from tpuserve.bench.loadgen import synthetic_image_jpeg
+        from tpuserve import preproc, native
+        payload = synthetic_image_jpeg(256)
+        def bench(fn, n=60):
+            fn()
+            t0 = time.perf_counter()
+            for _ in range(n): fn()
+            return round((time.perf_counter() - t0) / n * 1e3, 2)
+        res = {"exp": "host_costs", "jpeg_bytes": len(payload)}
+        res["pil_rgb_ms"] = bench(lambda: preproc.decode_image(payload, "image/jpeg", 256))
+        res["pil_rgb_yuv_ms"] = bench(lambda: preproc.rgb_to_yuv420(
+            preproc.decode_image(payload, "image/jpeg", 256)))
+        res["native_yuv_ms"] = bench(lambda: native.decode_yuv420(payload, 256)) \
+            if native.available() else None
+        import numpy as _np
+        arrs = [_np.zeros((224,224,3), _np.uint8) for _ in range(64)]
+        res["stack64_ms"] = bench(lambda: _np.stack(arrs), n=100)
+        print(json.dumps(res))
+    """,
+}
+
+
+def main() -> int:
+    results = {}
+    for name, code in EXPERIMENTS.items():
+        proc = subprocess.run(
+            [sys.executable, "-c", textwrap.dedent(code)],
+            capture_output=True, text=True, timeout=1200, cwd="/root/repo",
+        )
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        try:
+            results[name] = json.loads(line)
+        except json.JSONDecodeError:
+            results[name] = {"exp": name, "error": proc.stderr[-2000:]}
+        print(json.dumps(results[name]), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

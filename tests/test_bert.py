@@ -1,0 +1,183 @@
+"""BERT family + text path (C3/C4, SURVEY.md §3d): tokenizer behavior,
+(batch, seq) bucketing, seq-bucket/padding invariance, HTTP end-to-end.
+VERDICT.md r2 item 3."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from tpuserve.config import ModelConfig
+from tpuserve.models import build
+from tpuserve.text import (
+    CLS, PAD, SEP, UNK, WordPieceTokenizer, basic_tokenize, synthetic_vocab,
+)
+
+TINY = dict(layers=2, d_model=32, heads=2, d_ff=64, vocab_size=512)
+
+
+def tiny_cfg(**over) -> ModelConfig:
+    base = dict(
+        name="bert", family="bert", batch_buckets=[1, 2],
+        seq_buckets=[8, 16], deadline_ms=5.0, dtype="float32",
+        num_classes=4, parallelism="single", request_timeout_ms=30_000.0,
+        options=dict(TINY),
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+# -- tokenizer ----------------------------------------------------------------
+
+def test_basic_tokenize():
+    assert basic_tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+    assert basic_tokenize("Café") == ["cafe"]  # accent stripped
+    assert basic_tokenize("a中b") == ["a", "中", "b"]  # CJK isolated
+
+
+def test_wordpiece_greedy_longest_match():
+    vocab = {t: i for i, t in enumerate(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+         "un", "##aff", "##able", "##a", "##ff", "aff"])}
+    tok = WordPieceTokenizer(vocab)
+    assert tok.wordpiece("unaffable") == ["un", "##aff", "##able"]
+    assert tok.wordpiece("zzz") == [UNK]
+
+
+def test_encode_pads_and_masks():
+    tok = WordPieceTokenizer(synthetic_vocab(2048))
+    ids, mask = tok.encode("hello world", 16)
+    assert ids.shape == (16,) and mask.shape == (16,)
+    assert ids[0] == tok.cls_id
+    n = int(mask.sum())
+    assert ids[n - 1] == tok.sep_id
+    assert np.all(ids[n:] == tok.pad_id) and np.all(mask[n:] == 0)
+
+
+def test_encode_truncates():
+    tok = WordPieceTokenizer(synthetic_vocab(2048))
+    ids, mask = tok.encode("word " * 100, 8)
+    assert ids.shape == (8,) and int(mask.sum()) == 8
+    assert ids[-1] == tok.sep_id
+
+
+def test_synthetic_vocab_deterministic_and_unkless():
+    v1, v2 = synthetic_vocab(4096), synthetic_vocab(4096)
+    assert v1 == v2
+    tok = WordPieceTokenizer(v1)
+    assert UNK not in tok.tokenize("arbitrary ascii text 123!")
+
+
+def test_vocab_file_roundtrip(tmp_path):
+    p = tmp_path / "vocab.txt"
+    p.write_text("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                            "hello", "##s"]))
+    tok = WordPieceTokenizer.from_vocab_file(str(p))
+    assert tok.tokenize("hellos") == ["hello", "##s"]
+
+
+# -- model + bucketing --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    """Tiny BERT behind the real runtime (module-scoped: compiles 4 buckets)."""
+    from tpuserve.runtime import build_runtime
+
+    model = build(tiny_cfg())
+    rt = build_runtime(model)
+    return model, rt
+
+
+def test_buckets_cross_product(served):
+    model, rt = served
+    assert model.buckets() == [(1, 8), (1, 16), (2, 8), (2, 16)]
+    assert sorted(rt.executables) == sorted(model.buckets())
+
+
+def test_group_key_picks_seq_bucket(served):
+    model, _ = served
+    short = model.host_decode(b'{"text": "hi"}', "application/json")
+    long = model.host_decode(
+        json.dumps({"text": "many words " * 6}).encode(), "application/json")
+    assert model.group_key(short) == 8
+    assert model.group_key(long) == 16
+    assert model.bucket_for(2, group=8) == (2, 8)
+    assert model.bucket_for(3, group=16) == (2, 16)  # clamps to largest batch
+
+
+def test_seq_bucket_invariance(served):
+    """The same text produces the same logits in the 8- and 16-seq buckets:
+    padded lanes and extra padded positions cannot leak into real lanes."""
+    model, rt = served
+    item = model.host_decode(b'{"text": "hello world"}', "application/json")
+    out8 = rt.fetch(rt.run((1, 8), model.assemble([item], (1, 8))))
+    out16 = rt.fetch(rt.run((1, 16), model.assemble([item], (1, 16))))
+    np.testing.assert_allclose(out8["probs"], out16["probs"], atol=1e-5)
+    np.testing.assert_array_equal(out8["indices"], out16["indices"])
+
+
+def test_batch_padding_invariance(served):
+    """A request's result is identical alone vs sharing a padded batch."""
+    model, rt = served
+    a = model.host_decode(b'{"text": "alpha beta"}', "application/json")
+    b_ = model.host_decode(b'{"text": "gamma"}', "application/json")
+    solo = rt.fetch(rt.run((1, 8), model.assemble([a], (1, 8))))
+    pair = rt.fetch(rt.run((2, 8), model.assemble([a, b_], (2, 8))))
+    np.testing.assert_allclose(solo["probs"][0], pair["probs"][0], atol=1e-5)
+
+
+def test_text_plain_body(served):
+    model, _ = served
+    item = model.host_decode(b"raw text body", "text/plain")
+    assert item.dtype == np.int32 and item.ndim == 1
+
+
+def test_bad_json_raises(served):
+    model, _ = served
+    with pytest.raises(ValueError):
+        model.host_decode(b'{"no_text": 1}', "application/json")
+
+
+# -- HTTP end-to-end ----------------------------------------------------------
+
+def test_bert_http_end_to_end():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpuserve.config import ServerConfig
+    from tpuserve.server import ServerState, make_app
+
+    cfg = ServerConfig(models=[tiny_cfg()], decode_threads=2)
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/v1/models/bert:classify",
+                data=json.dumps({"text": "serve this text please"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert len(body["top_k"]) == 4
+            assert abs(sum(e["prob"] for e in body["top_k"]) - 1.0) < 1e-3
+
+            # per-(batch, seq) executables are visible in the inventory
+            resp = await client.get("/v1/models")
+            inv = await resp.json()
+            assert inv["bert"]["buckets"] == [[1, 8], [1, 16], [2, 8], [2, 16]]
+
+            # malformed JSON -> 400
+            resp = await client.post(
+                "/v1/models/bert:classify", data=b"{oops",
+                headers={"Content-Type": "application/json"})
+            assert resp.status == 400
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()

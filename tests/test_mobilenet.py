@@ -1,0 +1,101 @@
+"""MobileNetV3-Large (config 2): shape/param sanity, replica-mode serving on
+the 8-fake-device mesh, HTTP end-to-end. VERDICT.md r2 item 4."""
+
+import asyncio
+import io
+
+import jax
+import numpy as np
+import pytest
+
+from tpuserve.config import ModelConfig, ServerConfig
+from tpuserve.models import build
+
+
+def mnv3_cfg(**over) -> ModelConfig:
+    base = dict(
+        name="mnv3", family="mobilenetv3", batch_buckets=[1, 2],
+        deadline_ms=2.0, dtype="float32", num_classes=10,
+        parallelism="replica", request_timeout_ms=30_000.0,
+        image_size=64, wire_size=64,  # small spatial dims: fast CPU compile
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def test_module_shapes_and_param_count():
+    """Full-size MobileNetV3-Large has ~5.5M params (published figure)."""
+    model = build(ModelConfig(name="m", family="mobilenetv3",
+                              num_classes=1000, dtype="float32"))
+    params = jax.eval_shape(model.init_params, jax.random.key(0))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+    assert 5.3e6 < n < 5.7e6, n
+
+
+@pytest.fixture(scope="module")
+def served():
+    from tpuserve.runtime import build_runtime
+
+    model = build(mnv3_cfg())
+    rt = build_runtime(model)
+    return model, rt
+
+
+def test_replica_mode_one_executable_per_device(served):
+    model, rt = served
+    assert rt.mode == "replica"
+    assert len(rt.meshes) == len(jax.devices()) == 8
+    assert len(rt.executables[(1,)]) == 8
+
+
+def test_forward_and_round_robin(served):
+    model, rt = served
+    img = np.random.default_rng(0).integers(0, 255, (1, 64, 64, 3), np.uint8)
+    out1 = rt.fetch(rt.run((1,), img))
+    out2 = rt.fetch(rt.run((1,), img))  # different replica, same params/seed
+    assert out1["probs"].shape == (1, 5)
+    np.testing.assert_allclose(out1["probs"], out2["probs"], atol=1e-5)
+    assert np.all(np.diff(out1["probs"][0]) <= 1e-7)  # sorted top-k
+
+
+def test_padding_lanes_inert(served):
+    model, rt = served
+    img = np.random.default_rng(1).integers(0, 255, (64, 64, 3), np.uint8)
+    solo = rt.fetch(rt.run((1,), model.assemble([img], (1,))))
+    padded = rt.fetch(rt.run((2,), model.assemble([img], (2,))))
+    np.testing.assert_allclose(solo["probs"][0], padded["probs"][0], atol=1e-5)
+
+
+def test_mobilenet_http_end_to_end():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpuserve.server import ServerState, make_app
+
+    cfg = ServerConfig(models=[mnv3_cfg()], decode_threads=2)
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            buf = io.BytesIO()
+            np.save(buf, np.random.default_rng(0).integers(
+                0, 255, (64, 64, 3), dtype=np.uint8))
+            resp = await client.post(
+                "/v1/models/mnv3:classify", data=buf.getvalue(),
+                headers={"Content-Type": "application/x-npy"})
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert len(body["top_k"]) == 5
+            resp = await client.get("/v1/models")
+            inv = await resp.json()
+            assert inv["mnv3"]["mode"] == "replica"
+            assert inv["mnv3"]["replicas"] == 8
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()

@@ -116,6 +116,21 @@ class ServingModel(abc.ABC):
     def host_postprocess(self, outputs: Outputs, n_valid: int) -> list[Any]:
         """Convert device outputs (already np) to n_valid JSON-able results."""
 
+    @staticmethod
+    def format_top_k(outputs: dict, n_valid: int) -> list[dict]:
+        """Shared classifier response shape: {"top_k": [{class, prob}, ...]}."""
+        probs = outputs["probs"][:n_valid]
+        idx = outputs["indices"][:n_valid]
+        return [
+            {
+                "top_k": [
+                    {"class": int(i), "prob": float(p)}
+                    for i, p in zip(idx[r], probs[r])
+                ]
+            }
+            for r in range(n_valid)
+        ]
+
     def assemble(self, items: list[Any], bucket: tuple) -> HostBatch:
         """Stack decoded items into one padded host batch for `bucket`.
 

@@ -1,0 +1,207 @@
+"""BERT-base text classification (SURVEY.md §2 C4, §3d; BASELINE.json config 3).
+
+TPU-first shaping decisions:
+- **Static (batch, seq) buckets**: every (batch_bucket, seq_bucket) pair is
+  its own AOT-compiled executable; the batcher groups requests by seq bucket
+  (``group_key``) so short texts never pay long-sequence FLOPs. This is the
+  build's answer to the reference-era "dynamic seq-len" problem — bucketed
+  padding, per BASELINE.json.
+- Tokenization on the host threadpool (pure Python WordPiece,
+  ``tpuserve.text``); only int32 (ids, mask) arrays cross to the device —
+  a few hundred bytes per request.
+- Attention masking is additive -1e9 bias from the padding mask, so padded
+  lanes cannot perturb real lanes (tested:
+  tests/test_bert.py::test_seq_bucket_invariance).
+- bf16 compute, f32 softmax/logits; post-LN residual blocks (original BERT),
+  gelu FFN, tanh pooler on [CLS], linear classifier.
+- TP partition rules shard QKV/out and FFN kernels on "model" when cfg.tp>1.
+
+Sizes come from ``cfg.options`` (layers/d_model/heads/d_ff/vocab_size) with
+BERT-base defaults; tests use tiny sizes. ``cfg.options["vocab_file"]`` loads
+a standard vocab.txt; otherwise the deterministic synthetic dev vocab is used
+(no network, no artifacts — SURVEY.md §7 hard part 8).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpuserve.config import ModelConfig
+from tpuserve.models.base import ServingModel
+from tpuserve.text import WordPieceTokenizer, synthetic_vocab
+
+
+class BertBlock(nn.Module):
+    heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, mask_bias):
+        # Post-LN (original BERT): sublayer -> add -> LayerNorm. Masking is an
+        # explicit additive bias inside attention_fn so the semantics stay
+        # bucket-invariant (padded keys get -1e9 before the f32 softmax).
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype, deterministic=True,
+            attention_fn=lambda q, k, v, **kw: _masked_attention(q, k, v, mask_bias),
+            name="attn")
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + attn(x))
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
+        return nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x + h)
+
+
+def _masked_attention(q, k, v, mask_bias):
+    """(B,S,H,D) attention with additive (B,1,1,S) key bias, f32 softmax."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = s + mask_bias
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class BertClassifier(nn.Module):
+    vocab_size: int
+    layers: int
+    d_model: int
+    heads: int
+    d_ff: int
+    max_seq: int
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, ids, mask):
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (self.max_seq, self.d_model))
+        x = x + pos[None, : ids.shape[1], :].astype(self.dtype)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+        mask_bias = (1.0 - mask.astype(jnp.float32))[:, None, None, :] * -1e9
+        for i in range(self.layers):
+            x = BertBlock(self.heads, self.d_ff, dtype=self.dtype,
+                          name=f"layer{i}")(x, mask_bias)
+        cls = x[:, 0, :]
+        pooled = jnp.tanh(nn.Dense(self.d_model, dtype=self.dtype, name="pooler")(cls))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(pooled)
+
+
+class BertServing(ServingModel):
+    def __init__(self, cfg: ModelConfig) -> None:
+        super().__init__(cfg)
+        opt = cfg.options
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.max_seq = max(cfg.seq_buckets)
+        vocab_file = opt.get("vocab_file")
+        if vocab_file:
+            self.tokenizer = WordPieceTokenizer.from_vocab_file(vocab_file)
+        else:
+            self.tokenizer = WordPieceTokenizer(
+                synthetic_vocab(int(opt.get("vocab_size", 8192))))
+        self.module = BertClassifier(
+            vocab_size=max(self.tokenizer.vocab.values()) + 1,
+            layers=int(opt.get("layers", 12)),
+            d_model=int(opt.get("d_model", 768)),
+            heads=int(opt.get("heads", 12)),
+            d_ff=int(opt.get("d_ff", 3072)),
+            max_seq=self.max_seq,
+            num_classes=cfg.num_classes,
+            dtype=self.dtype,
+        )
+        self.top_k = min(5, cfg.num_classes)
+
+    # -- params --------------------------------------------------------------
+    def init_params(self, rng: jax.Array) -> Any:
+        s = min(self.cfg.seq_buckets)
+        ids = jnp.zeros((1, s), jnp.int32)
+        mask = jnp.ones((1, s), jnp.int32)
+        return self.module.init(rng, ids, mask)
+
+    # -- shapes --------------------------------------------------------------
+    def buckets(self) -> list[tuple]:
+        return [(b, s) for b in self.cfg.batch_buckets for s in self.cfg.seq_buckets]
+
+    def bucket_for(self, n: int, group=None) -> tuple:
+        s = group if group is not None else max(self.cfg.seq_buckets)
+        for b in self.cfg.batch_buckets:
+            if b >= n:
+                return (b, s)
+        return (self.cfg.batch_buckets[-1], s)
+
+    def input_signature(self, bucket: tuple) -> Any:
+        b, s = bucket
+        return (
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+            jax.ShapeDtypeStruct((b, s), jnp.int32),
+        )
+
+    # -- device side ---------------------------------------------------------
+    def forward(self, params: Any, batch: Any) -> dict:
+        ids, mask = batch
+        logits = self.module.apply(params, ids, mask)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p, top_i = jax.lax.top_k(probs, self.top_k)
+        return {"probs": top_p, "indices": top_i}
+
+    # -- host side -----------------------------------------------------------
+    def host_decode(self, payload: bytes, content_type: str) -> np.ndarray:
+        """Request body -> unpadded int32 token ids (incl. [CLS]/[SEP])."""
+        if content_type.startswith("application/json"):
+            body = json.loads(payload.decode("utf-8"))
+            text = body.get("text")
+            if not isinstance(text, str):
+                raise ValueError('JSON body must contain "text": str')
+        else:
+            text = payload.decode("utf-8")
+        tok = self.tokenizer
+        pieces = tok.tokenize(text)  # once; encode() would re-tokenize
+        ids = [tok.cls_id] + [tok.vocab.get(t, tok.unk_id) for t in pieces]
+        ids = ids[: self.max_seq - 1] + [tok.sep_id]
+        return np.asarray(ids, np.int32)  # unpadded; assemble pads per bucket
+
+    def group_key(self, item: np.ndarray):
+        """Seq bucket for an unpadded id array -> batching group."""
+        for s in self.cfg.seq_buckets:
+            if s >= item.shape[0]:
+                return s
+        return max(self.cfg.seq_buckets)
+
+    def canary_item(self) -> np.ndarray:
+        return self.host_decode(b'{"text": "canary"}', "application/json")
+
+    def assemble(self, items: list[np.ndarray], bucket: tuple) -> Any:
+        b, s = bucket
+        ids = np.full((b, s), self.tokenizer.pad_id, np.int32)
+        mask = np.zeros((b, s), np.int32)
+        for i, it in enumerate(items):
+            n = min(it.shape[0], s)
+            ids[i, :n] = it[:n]
+            mask[i, :n] = 1
+        return ids, mask
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
+        return self.format_top_k(outputs, n_valid)
+
+    # -- parallelism ---------------------------------------------------------
+    def partition_rules(self):
+        if self.cfg.tp <= 1:
+            return [(".*", P())]
+        return [
+            (r"attn/(query|key|value)/kernel", P(None, "model", None)),
+            (r"attn/out/kernel", P("model", None, None)),
+            (r"mlp_up/kernel", P(None, "model")),
+            (r"mlp_down/kernel", P("model", None)),
+            (r".*", P()),
+        ]
+
+
+def create(cfg: ModelConfig) -> BertServing:
+    return BertServing(cfg)

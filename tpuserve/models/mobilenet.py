@@ -1,0 +1,146 @@
+"""MobileNetV3-Large classifier (SURVEY.md §2 C4; BASELINE.json config 2).
+
+The latency-optimized family: BASELINE.json names it "batch=1
+latency-optimized", so the intended serving mode is ``parallelism="replica"``
+— one single-device executable per chip with independent queues (SURVEY.md
+§2.1 DP mode b), small batch buckets, and a short flush deadline. The serving
+plumbing (wire formats, fused on-device preproc/top-k) is shared with the
+other vision families via tpuserve.models.vision.
+
+Architecture: MobileNetV3-Large (Howard et al. 2019): hard-swish/ReLU
+inverted-residual blocks with optional squeeze-excite, 5x5 depthwise convs in
+the later stages, 960->1280 head. Depthwise convs map to TPU fine in NHWC;
+squeeze-excite's global pool + tiny denses fuse into the surrounding
+elementwise work under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpuserve.config import ModelConfig
+from tpuserve.models.vision import ImageClassifierServing
+
+
+def hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def hard_swish(x):
+    return x * hard_sigmoid(x)
+
+
+def _divisible(v: float, d: int = 8) -> int:
+    out = max(d, int(v + d / 2) // d * d)
+    if out < 0.9 * v:
+        out += d
+    return out
+
+
+# (kernel, expanded, out, use_se, use_hs, stride) — MobileNetV3-Large table.
+V3_LARGE: tuple = (
+    (3, 16, 16, False, False, 1),
+    (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1),
+    (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1),
+    (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2),
+    (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1),
+    (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2),
+    (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+)
+
+
+class SqueezeExcite(nn.Module):
+    channels: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        mid = _divisible(self.channels / 4)
+        s = nn.relu(nn.Conv(mid, (1, 1), dtype=self.dtype, name="reduce")(s))
+        s = hard_sigmoid(nn.Conv(self.channels, (1, 1), dtype=self.dtype,
+                                 name="expand")(s))
+        return x * s
+
+
+class InvertedResidual(nn.Module):
+    kernel: int
+    expanded: int
+    out: int
+    use_se: bool
+    use_hs: bool
+    stride: int
+    bn_eps: float = 1e-3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        act = hard_swish if self.use_hs else nn.relu
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=True, momentum=0.99, epsilon=self.bn_eps,
+            dtype=self.dtype, name=name)
+        inp = x.shape[-1]
+        h = x
+        if self.expanded != inp:
+            h = act(bn("bn_expand")(nn.Conv(
+                self.expanded, (1, 1), use_bias=False, dtype=self.dtype,
+                name="expand")(h)))
+        h = act(bn("bn_dw")(nn.Conv(
+            self.expanded, (self.kernel, self.kernel),
+            strides=(self.stride, self.stride), padding="SAME",
+            feature_group_count=self.expanded, use_bias=False,
+            dtype=self.dtype, name="depthwise")(h)))
+        if self.use_se:
+            h = SqueezeExcite(self.expanded, dtype=self.dtype, name="se")(h)
+        h = bn("bn_project")(nn.Conv(
+            self.out, (1, 1), use_bias=False, dtype=self.dtype,
+            name="project")(h))
+        if self.stride == 1 and inp == self.out:
+            h = h + x
+        return h
+
+
+class MobileNetV3Large(nn.Module):
+    num_classes: int = 1000
+    blocks: Sequence = V3_LARGE
+    bn_eps: float = 1e-3
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=True, momentum=0.99, epsilon=self.bn_eps,
+            dtype=self.dtype, name=name)
+        x = hard_swish(bn("bn_stem")(nn.Conv(
+            16, (3, 3), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=self.dtype, name="stem")(x)))
+        for i, spec in enumerate(self.blocks):
+            x = InvertedResidual(*spec, bn_eps=self.bn_eps, dtype=self.dtype,
+                                 name=f"block{i}")(x)
+        last = self.blocks[-1][1]  # 960
+        x = hard_swish(bn("bn_head")(nn.Conv(
+            last, (1, 1), use_bias=False, dtype=self.dtype, name="head_conv")(x)))
+        x = jnp.mean(x, axis=(1, 2))
+        x = hard_swish(nn.Dense(1280, dtype=self.dtype, name="pre_logits")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(x)
+
+
+class MobileNetV3Serving(ImageClassifierServing):
+    def make_module(self, cfg: ModelConfig) -> MobileNetV3Large:
+        return MobileNetV3Large(num_classes=cfg.num_classes,
+                                dtype=jnp.dtype(cfg.dtype))
+
+
+def create(cfg: ModelConfig) -> MobileNetV3Serving:
+    return MobileNetV3Serving(cfg)

@@ -3,16 +3,20 @@
 TPU-first shaping decisions:
 - NHWC layout end-to-end (XLA:TPU's native conv layout; the MXU sees large
   bf16 convs with no transposes).
-- On-device fused preprocessing: uint8 (B,256,256,3) crosses PCIe; bilinear
-  resize to 224 + normalize happen in front of conv1 inside the executable
-  (tpuserve.preproc.device_prepare_images).
+- On-device fused preprocessing: uint8 wire crosses the link; bilinear resize
+  + normalize happen in front of conv1 inside the executable
+  (tpuserve.preproc; serving plumbing in tpuserve.models.vision).
 - On-device postprocessing: softmax + top-k (lax.top_k) so only (B,5) indices
   and probabilities cross back to the host.
 - BatchNorm folded to inference mode (use_running_average=True); batch_stats
   live in the param pytree like any other weights.
 
-Architecture: standard ResNet-v1.5 bottleneck [3,4,6,3] (He et al. 2015,
-torchvision convention: stride-2 on the 3x3 of downsampling bottlenecks).
+Architecture: standard ResNet bottleneck [3,4,6,3] (He et al. 2015). Two
+downsample conventions, selected by ``options.v1_downsample``:
+- False (default): v1.5 / torchvision — stride-2 on the 3x3 (conv2).
+- True: original v1 / Keras applications — stride-2 on the first 1x1 (conv1),
+  for weight-parity with models using that convention.
+``options.bn_eps`` matches the source framework (Keras uses 1.001e-5).
 """
 
 from __future__ import annotations
@@ -21,36 +25,37 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
-import numpy as np
 
-from tpuserve import preproc
 from tpuserve.config import ModelConfig
-from tpuserve.models.base import ServingModel
+from tpuserve.models.vision import ImageClassifierServing
 
 
 class Bottleneck(nn.Module):
     features: int
     strides: int = 1
     projection: bool = False
+    v1_downsample: bool = False
+    bn_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         bn = partial(nn.BatchNorm, use_running_average=True, momentum=0.9,
-                     epsilon=1e-5, dtype=self.dtype)
+                     epsilon=self.bn_eps, dtype=self.dtype)
+        s = (self.strides, self.strides)
+        s1, s2 = (s, (1, 1)) if self.v1_downsample else ((1, 1), s)
         residual = x
-        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = conv(self.features, (1, 1), strides=s1, name="conv1")(x)
         y = nn.relu(bn(name="bn1")(y))
-        y = conv(self.features, (3, 3), strides=(self.strides, self.strides), name="conv2")(y)
+        y = conv(self.features, (3, 3), strides=s2, name="conv2")(y)
         y = nn.relu(bn(name="bn2")(y))
         y = conv(self.features * 4, (1, 1), name="conv3")(y)
         y = bn(name="bn3")(y)
         if self.projection:
-            residual = conv(self.features * 4, (1, 1),
-                            strides=(self.strides, self.strides), name="proj_conv")(x)
+            residual = conv(self.features * 4, (1, 1), strides=s,
+                            name="proj_conv")(x)
             residual = bn(name="proj_bn")(residual)
         return nn.relu(y + residual)
 
@@ -58,14 +63,16 @@ class Bottleneck(nn.Module):
 class ResNet(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)
     num_classes: int = 1000
+    v1_downsample: bool = False
+    bn_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
 
     @nn.compact
     def __call__(self, x):
         x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, name="stem_conv")(x)
-        x = nn.BatchNorm(use_running_average=True, momentum=0.9, epsilon=1e-5,
-                         dtype=self.dtype, name="stem_bn")(x)
+        x = nn.BatchNorm(use_running_average=True, momentum=0.9,
+                         epsilon=self.bn_eps, dtype=self.dtype, name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
         for i, n_blocks in enumerate(self.stage_sizes):
@@ -73,72 +80,22 @@ class ResNet(nn.Module):
             for j in range(n_blocks):
                 strides = 2 if i > 0 and j == 0 else 1
                 x = Bottleneck(features, strides=strides, projection=(j == 0),
-                               dtype=self.dtype, name=f"stage{i + 1}_block{j + 1}")(x)
+                               v1_downsample=self.v1_downsample,
+                               bn_eps=self.bn_eps, dtype=self.dtype,
+                               name=f"stage{i + 1}_block{j + 1}")(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
         return x
 
 
-class ResNet50Serving(ServingModel):
-    TOP_K = 5
-
-    def __init__(self, cfg: ModelConfig) -> None:
-        super().__init__(cfg)
-        self.dtype = jnp.dtype(cfg.dtype)
-        self.module = ResNet(num_classes=cfg.num_classes, dtype=self.dtype)
-
-    def init_params(self, rng: jax.Array) -> Any:
-        dummy = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), self.dtype)
-        return self.module.init(rng, dummy)
-
-    def input_signature(self, bucket: tuple) -> Any:
-        (b,) = bucket
-        w = self.cfg.wire_size
-        if self.cfg.wire_format == "yuv420":
-            h = w // 2
-            return (
-                jax.ShapeDtypeStruct((b, w, w), jnp.uint8),
-                jax.ShapeDtypeStruct((b, h, h), jnp.uint8),
-                jax.ShapeDtypeStruct((b, h, h), jnp.uint8),
-            )
-        return jax.ShapeDtypeStruct((b, w, w, 3), jnp.uint8)
-
-    def forward(self, params: Any, batch: Any) -> dict:
-        if self.cfg.wire_format == "yuv420":
-            y, u, v = batch
-            x = preproc.device_prepare_images_yuv420(
-                y, u, v, self.cfg.image_size, dtype=self.dtype)
-        else:
-            x = preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
-        logits = self.module.apply(params, x)
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        top_p, top_i = jax.lax.top_k(probs, self.TOP_K)
-        return {"probs": top_p, "indices": top_i}
-
-    def host_decode(self, payload: bytes, content_type: str) -> Any:
-        if self.cfg.wire_format == "yuv420":
-            return preproc.decode_image_yuv420(payload, content_type, self.cfg.wire_size)
-        return preproc.decode_image(payload, content_type, edge=self.cfg.wire_size)
-
-    def canary_item(self) -> Any:
-        if self.cfg.wire_format == "yuv420":
-            w, h = self.cfg.wire_size, self.cfg.wire_size // 2
-            return (np.zeros((w, w), np.uint8), np.full((h, h), 128, np.uint8),
-                    np.full((h, h), 128, np.uint8))
-        return super().canary_item()
-
-    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
-        probs = outputs["probs"][:n_valid]
-        idx = outputs["indices"][:n_valid]
-        return [
-            {
-                "top_k": [
-                    {"class": int(i), "prob": float(p)}
-                    for i, p in zip(idx[r], probs[r])
-                ]
-            }
-            for r in range(n_valid)
-        ]
+class ResNet50Serving(ImageClassifierServing):
+    def make_module(self, cfg: ModelConfig) -> ResNet:
+        return ResNet(
+            num_classes=cfg.num_classes,
+            v1_downsample=bool(cfg.options.get("v1_downsample", False)),
+            bn_eps=float(cfg.options.get("bn_eps", 1e-5)),
+            dtype=jnp.dtype(cfg.dtype),
+        )
 
     def partition_rules(self):
         """TP rules (off unless cfg.tp > 1): shard wide convs/dense on 'model'."""

@@ -55,15 +55,7 @@ class ToyServing(ServingModel):
         return preproc.decode_image(payload, content_type, edge=EDGE)
 
     def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
-        return [
-            {
-                "top_k": [
-                    {"class": int(i), "prob": float(p)}
-                    for i, p in zip(outputs["indices"][r], outputs["probs"][r])
-                ]
-            }
-            for r in range(n_valid)
-        ]
+        return self.format_top_k(outputs, n_valid)
 
     def canary_item(self) -> np.ndarray:
         return np.zeros((EDGE, EDGE, 3), dtype=np.uint8)

@@ -1,0 +1,78 @@
+"""Shared serving plumbing for image-classifier families (C4).
+
+Every vision classifier serves the same way (SURVEY.md §3c): host decodes to
+the configured wire format (rgb8 or yuv420 planes), the device executable
+fuses resize/normalize in front of the network and softmax+top-k behind it,
+and the host formats the tiny (B, k) results. Families subclass and provide
+``make_module`` (the flax network) and optionally ``partition_rules``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve import preproc
+from tpuserve.config import ModelConfig
+from tpuserve.models.base import ServingModel
+
+
+class ImageClassifierServing(ServingModel):
+    """ServingModel base for (B, H, W, 3) -> class-probability models."""
+
+    TOP_K = 5
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        super().__init__(cfg)
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.module = self.make_module(cfg)
+        self.top_k = min(self.TOP_K, cfg.num_classes)
+
+    def make_module(self, cfg: ModelConfig):
+        raise NotImplementedError
+
+    def init_params(self, rng: jax.Array) -> Any:
+        dummy = jnp.zeros((1, self.cfg.image_size, self.cfg.image_size, 3), self.dtype)
+        return self.module.init(rng, dummy)
+
+    def input_signature(self, bucket: tuple) -> Any:
+        (b,) = bucket
+        w = self.cfg.wire_size
+        if self.cfg.wire_format == "yuv420":
+            h = w // 2
+            return (
+                jax.ShapeDtypeStruct((b, w, w), jnp.uint8),
+                jax.ShapeDtypeStruct((b, h, h), jnp.uint8),
+                jax.ShapeDtypeStruct((b, h, h), jnp.uint8),
+            )
+        return jax.ShapeDtypeStruct((b, w, w, 3), jnp.uint8)
+
+    def forward(self, params: Any, batch: Any) -> dict:
+        if self.cfg.wire_format == "yuv420":
+            y, u, v = batch
+            x = preproc.device_prepare_images_yuv420(
+                y, u, v, self.cfg.image_size, dtype=self.dtype)
+        else:
+            x = preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
+        logits = self.module.apply(params, x)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top_p, top_i = jax.lax.top_k(probs, self.top_k)
+        return {"probs": top_p, "indices": top_i}
+
+    def host_decode(self, payload: bytes, content_type: str) -> Any:
+        if self.cfg.wire_format == "yuv420":
+            return preproc.decode_image_yuv420(payload, content_type, self.cfg.wire_size)
+        return preproc.decode_image(payload, content_type, edge=self.cfg.wire_size)
+
+    def canary_item(self) -> Any:
+        if self.cfg.wire_format == "yuv420":
+            w, h = self.cfg.wire_size, self.cfg.wire_size // 2
+            return (np.zeros((w, w), np.uint8), np.full((h, h), 128, np.uint8),
+                    np.full((h, h), 128, np.uint8))
+        return super().canary_item()
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
+        return self.format_top_k(outputs, n_valid)
