@@ -2,15 +2,30 @@
 """Round benchmark harness (driver-run, real TPU).
 
 Serves ResNet-50 (random weights — no pretrained artifacts in the container)
-through the full production path — aiohttp HTTP -> batcher -> AOT-compiled
-XLA executable on the local TPU — drives it with the asyncio load generator,
-and prints ONE JSON line:
+through the full production path — aiohttp HTTP -> batcher -> XLA executables
+on the local TPU — drives it with the out-of-process load generator, and
+prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, ...}
+
+What the harness does, in order (all knobs env-overridable, defaults sane):
+
+1. Measures the REAL host->device link rate in a fresh subprocess (the dev
+   tunnel buffers writes; only a dependent read reveals the sustained rate —
+   see BASELINE.md "Link physics"). This gives the wire-bound ceiling.
+2. Serves with the perf machinery ON by default: session_mode="recycle"
+   (deferred epoch readback — per-batch D2H on this link costs seconds),
+   wire_format="yuv420" (1.5 B/px vs RGB's 3), native libjpeg plane decode.
+3. Closed-loop load for peak throughput; then open-loop at ~70% of that for
+   honest latency percentiles at a stated offered rate.
+4. ALWAYS prints the phase breakdown (queue/preproc/h2d/compute/postproc),
+   link ceiling math, and config to stderr — where every millisecond goes.
 
 Baseline for vs_baseline: the driver target is 12,000 img/s on v5e-8
-(BASELINE.md); this box exposes a single v5e core, so the per-chip share is
-12000/8 = 1500 img/s. vs_baseline = value / (1500 * n_local_chips).
+(BASELINE.md); this box exposes one chip, so the per-chip share is 1,500.
+The chip itself sustains ~10,000 img/s (BASELINE.md, measured); on this dev
+box the HTTP path is bound by the ~12 MB/s tunnel and the single host core,
+so the honest figures here are achieved img/s AND achieved/wire-ceiling.
 """
 
 from __future__ import annotations
@@ -18,119 +33,216 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import subprocess
 import sys
+import textwrap
 import time
 
 TARGET_V5E8_IMG_S = 12_000.0
 CHIPS_IN_TARGET = 8
 
 
-def main() -> int:
-    import jax
+def env_f(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
 
-    n_chips = max(1, len(jax.devices()))
-    per_chip_target = TARGET_V5E8_IMG_S / CHIPS_IN_TARGET * n_chips
 
+def measure_link_rate_mbps() -> float:
+    """Real sustained H2D rate, measured in a virgin subprocess: buffered
+    writes + one dependent read = wall-clock truth."""
+    code = textwrap.dedent("""
+        import time, json, numpy as np, jax, jax.numpy as jnp
+        mb, iters = 16, 5
+        arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
+        t0 = time.perf_counter()
+        devs = [jax.device_put(arr) for _ in range(iters)]
+        jax.block_until_ready(devs)
+        int(jnp.sum(devs[-1][:8].astype(jnp.int32)))  # force drain
+        print(json.dumps({"mbps": mb * iters / (time.perf_counter() - t0)}))
+    """)
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=600, cwd=os.path.dirname(os.path.abspath(__file__)))
+        return round(json.loads(proc.stdout.strip().splitlines()[-1])["mbps"], 1)
+    except Exception as e:  # noqa: BLE001
+        print(f"# link probe failed ({e}); ceiling math unavailable", file=sys.stderr)
+        return 0.0
+
+
+def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
     from tpuserve.config import ModelConfig, ServerConfig
-    from tpuserve.server import ServerState, make_app
-    from tpuserve.bench.loadgen import run_load, synthetic_image_jpeg, synthetic_image_npy
+    from tpuserve.server import ServerState
 
     cfg = ServerConfig(
         host="127.0.0.1",
-        port=18321,
-        decode_threads=16,
+        port=int(os.environ.get("BENCH_PORT", 18321)),
+        decode_threads=4,
+        decode_inline=True,  # 1-core host: skip the executor hop
         startup_canary=False,
+        compilation_cache_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jaxcache"),
         models=[
             ModelConfig(
                 name="resnet50",
                 family="resnet50",
-                batch_buckets=[64, 128],
-                deadline_ms=50.0,
+                batch_buckets=buckets,
+                deadline_ms=env_f("BENCH_DEADLINE_MS", 100.0),
                 dtype="bfloat16",
-                parallelism="sharded",
+                parallelism="sharded" if mode != "direct" else "single",
                 request_timeout_ms=60_000.0,
-                max_inflight=2,
-                wire_size=224,  # wire bytes dominate through the dev tunnel
+                max_inflight=4,
+                wire_size=wire,
+                wire_format=wire_format,
+                session_mode="recycle" if mode == "recycle" else "direct",
+                relay_workers=int(env_f("BENCH_WORKERS", 3)),
+                relay_slots=int(env_f("BENCH_SLOTS", 6)),
+                relay_epoch_images=int(env_f("BENCH_EPOCH_IMAGES", 2048)),
+                relay_epoch_ms=env_f("BENCH_EPOCH_MS", 3000.0),
             )
         ],
     )
-
-    t0 = time.time()
     state = ServerState(cfg)
     state.build()
-    print(f"# build+compile took {time.time() - t0:.1f}s", file=sys.stderr)
+    return state, cfg
 
-    async def run() -> dict:
-        from aiohttp import web
 
-        app = make_app(state)
-        runner = web.AppRunner(app, access_log=None)
-        await runner.setup()
-        site = web.TCPSite(runner, cfg.host, cfg.port)
-        await site.start()
-        try:
-            if os.environ.get("BENCH_PAYLOAD", "jpeg") == "jpeg":
-                payload = synthetic_image_jpeg()
-                ctype = "image/jpeg"
-            else:
-                payload = synthetic_image_npy()
-                ctype = "application/x-npy"
-            print(f"# payload: {len(payload)} bytes ({ctype})", file=sys.stderr)
-            url = f"http://{cfg.host}:{cfg.port}/v1/models/resnet50:classify"
-            duration = float(os.environ.get("BENCH_DURATION", "15"))
-            concurrency = int(os.environ.get("BENCH_CONCURRENCY", "256"))
-            warmup = float(os.environ.get("BENCH_WARMUP", "5"))
-            def debug_stats() -> None:
-                if not os.environ.get("BENCH_DEBUG"):
-                    return
-                stats = state.metrics.summary()
-                for section in ("latency", "counters", "gauges"):
-                    for k, v in sorted(stats[section].items()):
-                        print(f"# {k}: {v}", file=sys.stderr)
+async def run_server_and_load(state, cfg, payload: bytes, ctype: str,
+                              duration: float, warmup: float,
+                              concurrency: int, rate: float | None) -> dict:
+    from aiohttp import web
 
-            if os.environ.get("BENCH_INPROC"):
-                result = await run_load(url, payload, ctype, duration, concurrency, warmup)
-                debug_stats()
-                return result.summary()
-            # Default: load generator in a separate process so client-side
-            # socket/JSON work doesn't share the GIL with the serving process.
-            import tempfile
+    from tpuserve.server import make_app
 
-            with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
-                f.write(payload)
-                payload_path = f.name
-            proc = await asyncio.create_subprocess_exec(
-                sys.executable, "-m", "tpuserve", "bench",
-                "--url", f"http://{cfg.host}:{cfg.port}",
-                "--model", "resnet50", "--verb", "classify",
-                "--duration", str(duration), "--warmup", str(warmup),
-                "--concurrency", str(concurrency),
-                "--payload", payload_path, "--content-type", ctype,
-                stdout=asyncio.subprocess.PIPE,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-                env={**os.environ, "JAX_PLATFORMS": "cpu"},
-            )
-            out, _ = await proc.communicate()
-            os.unlink(payload_path)
-            debug_stats()
-            return json.loads(out.decode())
-        finally:
-            await runner.cleanup()
+    app = make_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.host, cfg.port)
+    await site.start()
+    try:
+        import tempfile
 
-    summary = asyncio.run(run())
-    print(f"# load result: {summary}", file=sys.stderr)
+        with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+            f.write(payload)
+            payload_path = f.name
+        args = [
+            sys.executable, "-m", "tpuserve", "bench",
+            "--url", f"http://{cfg.host}:{cfg.port}",
+            "--model", "resnet50", "--verb", "classify",
+            "--duration", str(duration), "--warmup", str(warmup),
+            "--concurrency", str(concurrency),
+            "--payload", payload_path, "--content-type", ctype,
+        ]
+        if rate:
+            args += ["--rate", str(rate)]
+        proc = await asyncio.create_subprocess_exec(
+            *args,
+            stdout=asyncio.subprocess.PIPE,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        out, _ = await proc.communicate()
+        os.unlink(payload_path)
+        return json.loads(out.decode())
+    finally:
+        await runner.cleanup()
 
-    value = summary["throughput_per_s"]
+
+def print_breakdown(state, header: str) -> None:
+    """Always-on phase breakdown (VERDICT r2 item 1): stderr, not opt-in."""
+    s = state.metrics.summary()
+    print(f"# --- {header}: phase breakdown (ms) ---", file=sys.stderr)
+    for key in sorted(s["latency"]):
+        v = s["latency"][key]
+        print(f"#   {key}: n={v['n']} mean={v['mean_ms']:.1f} "
+              f"p50={v['p50_ms']:.1f} p99={v['p99_ms']:.1f}", file=sys.stderr)
+    for key in sorted(s["counters"]):
+        print(f"#   {key}: {s['counters'][key]:.0f}", file=sys.stderr)
+    for name, rt in state.runtimes.items():
+        d = rt.describe()
+        if "stats" in d:
+            print(f"#   {name} pool: {d['stats']}", file=sys.stderr)
+
+
+def main() -> int:
+    t_all = time.time()
+    mode = os.environ.get("BENCH_MODE", "recycle")
+    wire_format = os.environ.get("BENCH_WIRE_FORMAT", "yuv420")
+    wire = int(env_f("BENCH_WIRE", 160))
+    buckets = [int(b) for b in os.environ.get("BENCH_BUCKETS", "128,256").split(",")]
+    duration = env_f("BENCH_DURATION", 20)
+    warmup = env_f("BENCH_WARMUP", 6)
+    concurrency = int(env_f("BENCH_CONCURRENCY", 384))
+
+    print(f"# config: mode={mode} wire={wire_format}@{wire} buckets={buckets}",
+          file=sys.stderr)
+
+    link_mbps = measure_link_rate_mbps()
+    bpp = 1.5 if wire_format == "yuv420" else 3.0
+    img_bytes = int(wire * wire * bpp)
+    ceiling = link_mbps * 1e6 / img_bytes if link_mbps else float("nan")
+    print(f"# link: {link_mbps} MB/s real sustained; wire {img_bytes} B/img "
+          f"-> wire-bound ceiling {ceiling:.0f} img/s", file=sys.stderr)
+
+    t0 = time.time()
+    state, cfg = build_state(mode, wire_format, wire, buckets)
+    print(f"# build+compile+prewarm took {time.time() - t0:.1f}s", file=sys.stderr)
+
+    from tpuserve.bench.loadgen import synthetic_image_jpeg, synthetic_image_npy
+
+    if os.environ.get("BENCH_PAYLOAD", "jpeg") == "jpeg":
+        payload, ctype = synthetic_image_jpeg(wire), "image/jpeg"
+    else:
+        payload, ctype = synthetic_image_npy(wire), "application/x-npy"
+    print(f"# payload: {len(payload)}-byte {wire}x{wire} {ctype}", file=sys.stderr)
+
+    async def run() -> tuple[dict, dict | None]:
+        closed = await run_server_and_load(
+            state, cfg, payload, ctype, duration, warmup, concurrency, None)
+        print(f"# closed-loop: {closed}", file=sys.stderr)
+        open_res = None
+        rate = env_f("BENCH_OPEN_RATE", 0.0) or round(0.7 * closed["throughput_per_s"])
+        if rate >= 1:
+            open_res = await run_server_and_load(
+                state, cfg, payload, ctype, min(duration, 15), 3, concurrency, rate)
+            print(f"# open-loop @ {rate}/s: {open_res}", file=sys.stderr)
+        return closed, open_res
+
+    closed, open_res = asyncio.run(run())
+    print_breakdown(state, f"mode={mode}")
+
+    n_chips = 1
+    try:
+        import jax
+
+        n_chips = max(1, len(jax.devices()))
+    except Exception:  # noqa: BLE001
+        pass
+    per_chip_target = TARGET_V5E8_IMG_S / CHIPS_IN_TARGET * n_chips
+
+    value = closed["throughput_per_s"]
     line = {
         "metric": "resnet50_http_throughput",
         "value": value,
         "unit": "img/s",
         "vs_baseline": round(value / per_chip_target, 4),
-        "p50_ms": summary["p50_ms"],
-        "p99_ms": summary["p99_ms"],
+        "p50_ms": closed["p50_ms"],
+        "p99_ms": closed["p99_ms"],
         "n_chips": n_chips,
-        "errors": summary["n_err"],
+        "errors": closed["n_err"],
+        "mode": mode,
+        "wire": f"{wire_format}@{wire}",
+        "link_mbps_measured": link_mbps,
+        "wire_ceiling_img_s": round(ceiling, 1) if ceiling == ceiling else None,
+        "pct_of_wire_ceiling": round(100 * value / ceiling, 1) if ceiling == ceiling else None,
+        "chip_compute_img_s": 10_070,  # measured, BASELINE.md "Link physics"
     }
+    if open_res:
+        line["open_loop"] = {
+            "offered_per_s": open_res.get("offered_rate_per_s"),
+            "throughput_per_s": open_res.get("throughput_per_s"),
+            "p50_ms": open_res.get("p50_ms"),
+            "p99_ms": open_res.get("p99_ms"),
+        }
+    print(f"# total bench wall time {time.time() - t_all:.0f}s", file=sys.stderr)
     print(json.dumps(line))
     return 0
 
