@@ -57,7 +57,8 @@ def measure_link_rate_mbps() -> float:
         devs = [jax.device_put(arr) for _ in range(iters)]
         jax.block_until_ready(devs)
         int(jnp.sum(devs[-1][:8].astype(jnp.int32)))  # force drain
-        print(json.dumps({"mbps": mb * iters / (time.perf_counter() - t0)}))
+        rate = (mb << 20) * iters / (time.perf_counter() - t0) / 1e6  # decimal MB/s
+        print(json.dumps({"mbps": rate}))
     """)
     try:
         proc = subprocess.run([sys.executable, "-c", code], capture_output=True,

@@ -1,0 +1,424 @@
+"""EfficientDet-D0 object detection (SURVEY.md §2 C4, §3f; BASELINE.json
+config 4) — the multi-output + NMS-postproc family.
+
+TPU-first shaping decisions (SURVEY.md §7 hard part 4):
+- **Everything static.** The classic detection tail (score filter -> sort ->
+  NMS -> variable-length result) is dynamic-shape hostile. Here the whole
+  tail runs on device with fixed shapes: top-``pre_nms`` candidate selection
+  by ``lax.top_k``, a pairwise-IoU matrix, and a ``lax.scan`` greedy
+  suppression loop emitting exactly ``max_dets`` slots plus a valid count.
+  The HTTP layer slices/filters on the host from that fixed (max_dets, 6)
+  array — no device round-trips, no recompiles, ever.
+- **Per-class NMS via coordinate offsetting**: candidate boxes are shifted by
+  ``class_id * 2.0`` (boxes are normalized to [0,1]) before the IoU matrix,
+  so boxes of different classes never overlap — one class-agnostic kernel
+  does per-class NMS. One detection per anchor (argmax class), the standard
+  "fast" variant.
+- Backbone EfficientNet-B0 (MBConv + squeeze-excite, swish), BiFPN with fast
+  normalized fusion, separable-conv class/box heads shared across levels with
+  per-level BatchNorm — the D0 configuration (64 fpn channels, 3 BiFPN
+  repeats, 3 head layers, 9 anchors/cell, levels P3..P7).
+- bf16 compute in convs; box decode, scoring, and NMS in f32.
+
+Sizes come from ``cfg.options`` so tests run a tiny variant on CPU:
+``det_classes`` (90), ``fpn_channels`` (64), ``fpn_repeats`` (3),
+``head_repeats`` (3), ``min_level``/``max_level`` (3/7), ``pre_nms`` (1024),
+``max_dets`` (100), ``iou_thresh`` (0.5), ``score_thresh`` (0.05),
+``anchor_scale`` (4.0), ``backbone_width``/``backbone_depth`` (1.0/1.0).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve.config import ModelConfig
+from tpuserve.models.vision import ImageClassifierServing
+
+# (expand_ratio, channels, repeats, stride, kernel) — EfficientNet-B0 table.
+B0_BLOCKS: tuple = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+
+def _round_filters(ch: int, width: float) -> int:
+    if width == 1.0:
+        return ch
+    ch *= width
+    new = max(8, int(ch + 4) // 8 * 8)
+    if new < 0.9 * ch:
+        new += 8
+    return int(new)
+
+
+def _round_repeats(r: int, depth: float) -> int:
+    return int(math.ceil(r * depth))
+
+
+class MBConv(nn.Module):
+    """Mobile inverted bottleneck with squeeze-excite (EfficientNet block)."""
+
+    expand: int
+    out: int
+    stride: int
+    kernel: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=True, momentum=0.99, epsilon=1e-3,
+            dtype=self.dtype, name=name)
+        inp = x.shape[-1]
+        mid = inp * self.expand
+        h = x
+        if self.expand != 1:
+            h = nn.swish(bn("bn_expand")(nn.Conv(
+                mid, (1, 1), use_bias=False, dtype=self.dtype, name="expand")(h)))
+        h = nn.swish(bn("bn_dw")(nn.Conv(
+            mid, (self.kernel, self.kernel), strides=(self.stride, self.stride),
+            padding="SAME", feature_group_count=mid, use_bias=False,
+            dtype=self.dtype, name="depthwise")(h)))
+        # Squeeze-excite at ratio 0.25 of the *input* channels (B0 spec).
+        s = jnp.mean(h, axis=(1, 2), keepdims=True)
+        se_mid = max(1, inp // 4)
+        s = nn.swish(nn.Conv(se_mid, (1, 1), dtype=self.dtype, name="se_reduce")(s))
+        s = nn.sigmoid(nn.Conv(mid, (1, 1), dtype=self.dtype, name="se_expand")(s))
+        h = h * s
+        h = bn("bn_project")(nn.Conv(
+            self.out, (1, 1), use_bias=False, dtype=self.dtype, name="project")(h))
+        if self.stride == 1 and inp == self.out:
+            h = h + x
+        return h
+
+
+class EfficientNetFeatures(nn.Module):
+    """EfficientNet backbone returning {level: feature} for levels 3..5
+    (strides 8/16/32). Width/depth multipliers give the tiny test variant."""
+
+    width: float = 1.0
+    depth: float = 1.0
+    blocks: Sequence = B0_BLOCKS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        bn = nn.BatchNorm(use_running_average=True, momentum=0.99, epsilon=1e-3,
+                          dtype=self.dtype, name="bn_stem")
+        x = nn.swish(bn(nn.Conv(_round_filters(32, self.width), (3, 3),
+                                strides=(2, 2), padding="SAME", use_bias=False,
+                                dtype=self.dtype, name="stem")(x)))
+        feats = {}
+        level, bi = 1, 0  # stem is stride 2 = level 1; first block group keeps it
+        for gi, (e, c, r, s, k) in enumerate(self.blocks):
+            c = _round_filters(c, self.width)
+            r = _round_repeats(r, self.depth)
+            if s == 2:
+                level += 1
+            for j in range(r):
+                x = MBConv(e, c, s if j == 0 else 1, k, dtype=self.dtype,
+                           name=f"block{bi}")(x)
+                bi += 1
+            # A level's final feature is the last block at that stride before
+            # the next downsampling group.
+            nxt = self.blocks[gi + 1][3] if gi + 1 < len(self.blocks) else 2
+            if nxt == 2 and level >= 3:
+                feats[level] = x
+        return feats
+
+
+class SeparableConv(nn.Module):
+    out: int
+    dtype: Any = jnp.bfloat16
+    bias_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Conv(x.shape[-1], (3, 3), padding="SAME",
+                    feature_group_count=x.shape[-1], use_bias=False,
+                    dtype=self.dtype, name="dw")(x)
+        return nn.Conv(self.out, (1, 1), dtype=self.dtype, use_bias=True,
+                       bias_init=self.bias_init, name="pw")(h)
+
+
+def _fuse(nodes: list, name: str, mdl: nn.Module):
+    """Fast normalized fusion (EfficientDet eq. 2): relu-weighted mean."""
+    w = mdl.param(name, nn.initializers.ones_init(), (len(nodes),), jnp.float32)
+    w = nn.relu(w)
+    w = w / (jnp.sum(w) + 1e-4)
+    return sum(w[i].astype(nodes[i].dtype) * nodes[i] for i in range(len(nodes)))
+
+
+def _resize_to(x, like):
+    if x.shape[1:3] == like.shape[1:3]:
+        return x
+    if x.shape[1] > like.shape[1]:  # downsample: stride-2 max pool
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+    return jax.image.resize(x, (x.shape[0],) + like.shape[1:3] + (x.shape[-1],),
+                            method="nearest")
+
+
+class BiFPNLayer(nn.Module):
+    channels: int
+    levels: Sequence[int]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats: dict) -> dict:
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=True, momentum=0.99, epsilon=1e-3,
+            dtype=self.dtype, name=name)
+        conv = lambda name: SeparableConv(self.channels, dtype=self.dtype, name=name)  # noqa: E731
+        lv = list(self.levels)
+        # Top-down pass: td[l] = fuse(in[l], up(td[l+1]))
+        td = {lv[-1]: feats[lv[-1]]}
+        for l in reversed(lv[:-1]):
+            up = _resize_to(td[l + 1], feats[l])
+            td[l] = nn.swish(bn(f"bn_td{l}")(conv(f"td{l}")(
+                _fuse([feats[l], up], f"w_td{l}", self))))
+        # Bottom-up pass: out[l] = fuse(in[l], td[l], down(out[l-1]))
+        out = {lv[0]: td[lv[0]]}
+        for l in lv[1:]:
+            down = _resize_to(out[l - 1], feats[l])
+            nodes = [feats[l], down] if l == lv[-1] else [feats[l], td[l], down]
+            out[l] = nn.swish(bn(f"bn_out{l}")(conv(f"out{l}")(
+                _fuse(nodes, f"w_out{l}", self))))
+        return out
+
+
+class PredictionHead(nn.Module):
+    """Class or box net: `repeats` separable convs shared across levels with
+    per-level BatchNorm, plus a shared final projection (EfficientDet design)."""
+
+    out_per_anchor: int
+    anchors: int
+    repeats: int
+    levels: Sequence[int]
+    final_bias: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, feats: dict) -> jax.Array:
+        convs = [SeparableConv(feats[self.levels[0]].shape[-1], dtype=self.dtype,
+                               name=f"conv{i}") for i in range(self.repeats)]
+        final = SeparableConv(
+            self.out_per_anchor * self.anchors, dtype=self.dtype,
+            bias_init=nn.initializers.constant(self.final_bias), name="final")
+        outs = []
+        for l in self.levels:
+            h = feats[l]
+            for i, c in enumerate(convs):
+                h = nn.swish(nn.BatchNorm(
+                    use_running_average=True, momentum=0.99, epsilon=1e-3,
+                    dtype=self.dtype, name=f"bn{i}_l{l}")(c(h)))
+            h = final(h)
+            b = h.shape[0]
+            outs.append(h.reshape(b, -1, self.out_per_anchor))
+        return jnp.concatenate(outs, axis=1)  # (B, total_anchors, out)
+
+
+class EfficientDet(nn.Module):
+    num_classes: int
+    fpn_channels: int = 64
+    fpn_repeats: int = 3
+    head_repeats: int = 3
+    min_level: int = 3
+    max_level: int = 7
+    num_anchors: int = 9
+    width: float = 1.0
+    depth: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        levels = list(range(self.min_level, self.max_level + 1))
+        feats = EfficientNetFeatures(self.width, self.depth, dtype=self.dtype,
+                                     name="backbone")(x)
+        bn = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=True, momentum=0.99, epsilon=1e-3,
+            dtype=self.dtype, name=name)
+        # Lateral 1x1 to fpn_channels; extra levels (P6, P7) from P5.
+        p = {}
+        for l in [lv for lv in levels if lv in feats]:
+            p[l] = bn(f"bn_lat{l}")(nn.Conv(self.fpn_channels, (1, 1),
+                                            dtype=self.dtype, name=f"lat{l}")(feats[l]))
+        top = max(feats)
+        prev = p.get(top, feats[top])
+        for l in range(top + 1, self.max_level + 1):
+            if l == top + 1:
+                prev = bn(f"bn_lat{l}")(nn.Conv(self.fpn_channels, (1, 1),
+                                                dtype=self.dtype, name=f"lat{l}")(prev))
+            p[l] = nn.max_pool(prev, (3, 3), strides=(2, 2), padding="SAME")
+            prev = p[l]
+        for i in range(self.fpn_repeats):
+            p = BiFPNLayer(self.fpn_channels, levels, dtype=self.dtype,
+                           name=f"bifpn{i}")(p)
+        cls = PredictionHead(self.num_classes, self.num_anchors,
+                             self.head_repeats, levels,
+                             final_bias=-math.log((1 - 0.01) / 0.01),
+                             dtype=self.dtype, name="class_net")(p)
+        box = PredictionHead(4, self.num_anchors, self.head_repeats, levels,
+                             dtype=self.dtype, name="box_net")(p)
+        return cls.astype(jnp.float32), box.astype(jnp.float32)
+
+
+# -- anchors & the fixed-shape detection tail --------------------------------
+
+def make_anchors(image_size: int, min_level: int, max_level: int,
+                 anchor_scale: float = 4.0) -> np.ndarray:
+    """(A, 4) [yc, xc, h, w] in pixels: 3 octave scales x 3 aspect ratios per
+    cell per level — the EfficientDet anchor grid."""
+    out = []
+    for level in range(min_level, max_level + 1):
+        stride = 2 ** level
+        # SAME-padded stride-2 convs/pools produce ceil-sized feature maps
+        # (repeated ceil-halving == ceil(size / stride)), so the grid must
+        # match or top_k indices would clamp against a short anchor table.
+        n = max(1, -(-image_size // stride))
+        yc, xc = np.meshgrid(
+            (np.arange(n) + 0.5) * stride, (np.arange(n) + 0.5) * stride,
+            indexing="ij")
+        cells = np.stack([yc.ravel(), xc.ravel()], axis=-1)  # (n*n, 2)
+        sizes = []
+        for octave in (0.0, 1.0 / 3.0, 2.0 / 3.0):
+            base = anchor_scale * stride * (2.0 ** octave)
+            for ratio in (0.5, 1.0, 2.0):
+                sizes.append((base / math.sqrt(ratio), base * math.sqrt(ratio)))
+        sizes = np.asarray(sizes)  # (9, 2) h, w
+        a = np.concatenate([
+            np.repeat(cells, len(sizes), axis=0),
+            np.tile(sizes, (len(cells), 1)),
+        ], axis=-1)
+        out.append(a)
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+def decode_boxes(reg: jax.Array, anchors: jax.Array, image_size: int) -> jax.Array:
+    """(A, 4) regression [ty, tx, th, tw] + anchors -> normalized corners."""
+    yc = reg[:, 0] * anchors[:, 2] + anchors[:, 0]
+    xc = reg[:, 1] * anchors[:, 3] + anchors[:, 1]
+    h = jnp.exp(jnp.clip(reg[:, 2], -8.0, 8.0)) * anchors[:, 2]
+    w = jnp.exp(jnp.clip(reg[:, 3], -8.0, 8.0)) * anchors[:, 3]
+    boxes = jnp.stack([yc - h / 2, xc - w / 2, yc + h / 2, xc + w / 2], axis=-1)
+    return jnp.clip(boxes / image_size, 0.0, 1.0)
+
+
+def pairwise_iou(boxes: jax.Array) -> jax.Array:
+    """(K, 4) corner boxes -> (K, K) IoU, all static shapes."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * jnp.maximum(
+        boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def fixed_nms(boxes: jax.Array, scores: jax.Array, classes: jax.Array,
+              max_dets: int, iou_thresh: float, score_thresh: float):
+    """Greedy NMS with static shapes: `max_dets` scan steps over a K-candidate
+    set, suppressing by a precomputed IoU matrix. Per-class via the
+    class-offset trick (boxes normalized to [0,1], offset 2.0 * class)."""
+    shifted = boxes + (classes.astype(jnp.float32) * 2.0)[:, None]
+    iou = pairwise_iou(shifted)  # (K, K)
+
+    def step(alive, _):
+        idx = jnp.argmax(alive)
+        s = alive[idx]
+        valid = s > score_thresh
+        suppress = iou[idx] > iou_thresh  # includes idx itself (IoU 1)
+        alive = jnp.where(suppress, 0.0, alive)
+        alive = alive.at[idx].set(0.0)
+        return alive, (idx, jnp.where(valid, s, 0.0), valid)
+
+    _, (idxs, out_scores, valids) = jax.lax.scan(
+        step, scores, None, length=max_dets)
+    return {
+        "boxes": boxes[idxs],                       # (max_dets, 4)
+        "scores": out_scores,                       # (max_dets,)
+        "classes": jnp.where(valids, classes[idxs], -1),  # (max_dets,)
+        "n": jnp.sum(valids.astype(jnp.int32)),
+    }
+
+
+class EfficientDetServing(ImageClassifierServing):
+    """Detection serving: shared vision wire/decode plumbing, detect tail."""
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        o = cfg.options
+        self.det_classes = int(o.get("det_classes", 90))
+        self.pre_nms = int(o.get("pre_nms", 1024))
+        self.max_dets = int(o.get("max_dets", 100))
+        self.iou_thresh = float(o.get("iou_thresh", 0.5))
+        self.score_thresh = float(o.get("score_thresh", 0.05))
+        self.min_level = int(o.get("min_level", 3))
+        self.max_level = int(o.get("max_level", 7))
+        super().__init__(cfg)
+        self.anchors = jnp.asarray(make_anchors(
+            cfg.image_size, self.min_level, self.max_level,
+            float(o.get("anchor_scale", 4.0))))
+
+    def make_module(self, cfg: ModelConfig) -> EfficientDet:
+        o = cfg.options
+        return EfficientDet(
+            num_classes=self.det_classes,
+            fpn_channels=int(o.get("fpn_channels", 64)),
+            fpn_repeats=int(o.get("fpn_repeats", 3)),
+            head_repeats=int(o.get("head_repeats", 3)),
+            min_level=self.min_level,
+            max_level=self.max_level,
+            width=float(o.get("backbone_width", 1.0)),
+            depth=float(o.get("backbone_depth", 1.0)),
+            dtype=jnp.dtype(cfg.dtype),
+        )
+
+    def forward(self, params: Any, batch: Any) -> dict:
+        x = self.prepare_batch(batch)
+        cls_logits, box_reg = self.module.apply(params, x)  # (B,A,C), (B,A,4)
+        probs = jax.nn.sigmoid(cls_logits)
+        best = jnp.max(probs, axis=-1)                      # (B, A)
+        best_cls = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+        k = min(self.pre_nms, best.shape[1])
+
+        def per_image(scores_a, cls_a, reg_a):
+            top_s, top_i = jax.lax.top_k(scores_a, k)
+            boxes = decode_boxes(reg_a[top_i], self.anchors[top_i],
+                                 self.cfg.image_size)
+            return fixed_nms(boxes, top_s, cls_a[top_i],
+                             self.max_dets, self.iou_thresh, self.score_thresh)
+
+        return jax.vmap(per_image)(best, best_cls, box_reg)
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
+        res = []
+        for r in range(n_valid):
+            n = int(outputs["n"][r])
+            dets = []
+            for j in range(self.max_dets):
+                if outputs["classes"][r][j] < 0:
+                    continue
+                dets.append({
+                    "box": [round(float(c), 5) for c in outputs["boxes"][r][j]],
+                    "score": round(float(outputs["scores"][r][j]), 5),
+                    "class": int(outputs["classes"][r][j]),
+                })
+                if len(dets) == n:
+                    break
+            res.append({"detections": dets, "num_detections": n})
+        return res
+
+
+def create(cfg: ModelConfig) -> EfficientDetServing:
+    return EfficientDetServing(cfg)

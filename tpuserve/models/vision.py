@@ -50,13 +50,17 @@ class ImageClassifierServing(ServingModel):
             )
         return jax.ShapeDtypeStruct((b, w, w, 3), jnp.uint8)
 
-    def forward(self, params: Any, batch: Any) -> dict:
+    def prepare_batch(self, batch: Any) -> Any:
+        """Wire-format dispatch: device-side unpack/resize/normalize (jittable).
+        Shared by every vision family (classifiers and detection)."""
         if self.cfg.wire_format == "yuv420":
             y, u, v = batch
-            x = preproc.device_prepare_images_yuv420(
+            return preproc.device_prepare_images_yuv420(
                 y, u, v, self.cfg.image_size, dtype=self.dtype)
-        else:
-            x = preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
+        return preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
+
+    def forward(self, params: Any, batch: Any) -> dict:
+        x = self.prepare_batch(batch)
         logits = self.module.apply(params, x)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         top_p, top_i = jax.lax.top_k(probs, self.top_k)
