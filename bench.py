@@ -14,8 +14,9 @@ What the harness does, in order (all knobs env-overridable, defaults sane):
    tunnel buffers writes; only a dependent read reveals the sustained rate —
    see BASELINE.md "Link physics"). This gives the wire-bound ceiling.
 2. Serves with the perf machinery ON by default: session_mode="recycle"
-   (deferred epoch readback — per-batch D2H on this link costs seconds),
-   wire_format="yuv420" (1.5 B/px vs RGB's 3), native libjpeg plane decode.
+   (deferred epoch readback — a dependent per-batch D2H costs ~190 ms RTT
+   on this link), wire_format="yuv420" (1.5 B/px vs RGB's 3), native libjpeg
+   plane decode.
 3. Closed-loop load for peak throughput; then open-loop at ~70% of that for
    honest latency percentiles at a stated offered rate.
 4. ALWAYS prints the phase breakdown (queue/preproc/h2d/compute/postproc),
@@ -234,7 +235,7 @@ def main() -> int:
         "link_mbps_measured": link_mbps,
         "wire_ceiling_img_s": round(ceiling, 1) if ceiling == ceiling else None,
         "pct_of_wire_ceiling": round(100 * value / ceiling, 1) if ceiling == ceiling else None,
-        "chip_compute_img_s": 10_070,  # measured, BASELINE.md "Link physics"
+        "chip_compute_img_s": 10_564,  # measured, BASELINE.md "Link physics"
     }
     if open_res:
         line["open_loop"] = {

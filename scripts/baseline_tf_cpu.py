@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Measure the TF-CPU SavedModel baseline for BASELINE.md config 1
+(VERDICT r2 item 6; SURVEY §6 "first measurement action").
+
+Builds Keras-applications ResNet50 (random weights — no pretrained artifacts
+in this container), exports a SavedModel, reloads its serving signature, and
+measures single-image (batch=1) and batch=32 inference rates on the host CPU
+— the reference-shaped execution path (TF SavedModel, no CUDA available).
+
+Prints one JSON line; paste the numbers into BASELINE.md.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("CUDA_VISIBLE_DEVICES", "-1")
+
+import numpy as np
+
+
+def bench(fn, x, warmup=3, seconds=10.0) -> dict:
+    for _ in range(warmup):
+        fn(x)
+    n, t0 = 0, time.perf_counter()
+    lat = []
+    while time.perf_counter() - t0 < seconds:
+        t1 = time.perf_counter()
+        fn(x)
+        lat.append(time.perf_counter() - t1)
+        n += 1
+    dur = time.perf_counter() - t0
+    imgs = n * x.shape[0]
+    return {
+        "imgs_per_s": round(imgs / dur, 1),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 1),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1),
+        "n_calls": n,
+    }
+
+
+def main() -> int:
+    import tensorflow as tf
+
+    with tempfile.TemporaryDirectory(prefix="rn50_baseline_") as tmp:
+        model = tf.keras.applications.ResNet50(weights=None)
+        model.export(os.path.join(tmp, "sm"), verbose=False)
+        loaded = tf.saved_model.load(os.path.join(tmp, "sm"))
+        serve = loaded.signatures["serving_default"]
+
+        rng = np.random.default_rng(0)
+        out = {"metric": "tf_cpu_resnet50_savedmodel", "host_cpus": os.cpu_count()}
+        for b in (1, 32):
+            x = tf.constant(rng.uniform(0, 1, (b, 224, 224, 3)).astype(np.float32))
+            out[f"batch{b}"] = bench(lambda t: serve(t), x)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,7 +79,7 @@ class ModelConfig:
     # - "recycle": deferred-readback worker pool — results are read back in
     #   bulk once per epoch by single-use worker processes. For links where
     #   per-batch device->host reads destroy throughput (see BASELINE.md
-    #   "relay physics").
+    #   "Link physics").
     session_mode: str = "direct"
     # recycle mode: worker processes to pre-warm at startup.
     relay_workers: int = 2

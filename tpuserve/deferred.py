@@ -1,12 +1,14 @@
 """Deferred-readback execution pool (SURVEY.md §2 C5/C12; VERDICT.md r1 item 2).
 
-Motivation — measured on the dev tunnel (see BASELINE.md "relay physics"):
-the PJRT relay that fronts the TPU buffers host->device transfers at memcpy
-speed (~2 GB/s apparent) and drains them to the device at the link's real
-rate (~47 MB/s), but the FIRST device->host read permanently switches the
-session into a synchronous mode (~115 ms fixed cost per transfer, no
-pipelining). A serving process that reads results after every batch therefore
-runs an order of magnitude under the link rate.
+Motivation — measured on the dev tunnel (see BASELINE.md "Link physics"):
+the PJRT relay that fronts the TPU buffers host->device transfers
+asynchronously, but a DEPENDENT device->host read costs a ~190 ms round trip
+(r3 measurement; 214 ms/batch observed vs 24 ms of compute for ResNet-50
+batch 256). A serving process that reads results after every batch is
+therefore latency-bound at ~5 batches/s regardless of TPU speed. (An r2
+measurement also saw the first D2H permanently degrade the session's H2D
+rate; the r3 re-measurement with fair warm-up did NOT reproduce that —
+per-batch readback RTT alone is the standing justification.)
 
 The TPU-native answer is to make device->host readback *rare* instead of
 per-batch:

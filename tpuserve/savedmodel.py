@@ -90,16 +90,33 @@ _CKPT_SUFFIX = "/.ATTRIBUTES/VARIABLE_VALUE"
 
 
 def extract_saved_model_variables(path: str) -> dict[str, np.ndarray]:
-    """Flat {path: np.ndarray} from a TF2 SavedModel's variables checkpoint.
+    """Flat {name: np.ndarray} from a TF2 SavedModel.
 
-    Reads the ``variables/`` checkpoint shards directly (no graph execution,
-    no object restoration): keys are object-graph paths with the checkpoint
-    attribute suffix stripped, e.g. ``layer_1/kernel``.
+    Prefers the loaded object's ``variables`` collection, whose names are the
+    semantic layer paths (``conv1_conv/kernel``) that family
+    ``import_tf_variables`` mappings are written against; the ``:0`` tensor
+    suffix is stripped. Falls back to reading the ``variables/`` checkpoint
+    shards directly (object-graph paths like ``layer_with_weights-0/kernel``)
+    for SavedModels whose root object exposes no variables.
     """
     import tensorflow as tf  # lazy: only on import paths
 
-    reader = tf.train.load_checkpoint(os.path.join(path, "variables", "variables"))
     out: dict[str, np.ndarray] = {}
+    try:
+        loaded = tf.saved_model.load(path)
+        semantic: dict[str, np.ndarray] = {}
+        for v in getattr(loaded, "variables", None) or ():
+            semantic[v.name.split(":")[0]] = np.asarray(v.numpy())
+        # Commit only a complete read: a mid-loop failure must not hand a
+        # truncated dict to import_tf_variables when the checkpoint reader
+        # below could produce the full set.
+        out = semantic
+    except Exception:  # noqa: BLE001 — fall through to the checkpoint reader
+        log.warning("tf.saved_model.load failed for %s; using checkpoint reader", path)
+    if out:
+        return out
+
+    reader = tf.train.load_checkpoint(os.path.join(path, "variables", "variables"))
     for key in reader.get_variable_to_shape_map():
         name = key[: -len(_CKPT_SUFFIX)] if key.endswith(_CKPT_SUFFIX) else key
         if name.startswith("_CHECKPOINTABLE_OBJECT_GRAPH") or "OBJECT_CONFIG" in name:
