@@ -13,10 +13,13 @@ What the harness does, in order (all knobs env-overridable, defaults sane):
 1. Measures the REAL host->device link rate in a fresh subprocess (the dev
    tunnel buffers writes; only a dependent read reveals the sustained rate —
    see BASELINE.md "Link physics"). This gives the wire-bound ceiling.
-2. Serves with the perf machinery ON by default: session_mode="recycle"
-   (deferred epoch readback — a dependent per-batch D2H costs ~190 ms RTT
-   on this link), wire_format="yuv420" (1.5 B/px vs RGB's 3), native libjpeg
-   plane decode.
+2. Serves wire_format="yuv420" (1.5 B/px vs RGB's 3) with the native libjpeg
+   plane decoder. BENCH_MODE picks the execution path; the default is
+   "direct" with pipelined dispatch, which measured an order of magnitude
+   faster than "recycle" here (639 vs ~35 img/s, r3) — the direct path's
+   small top-k readbacks pipeline well enough that deferred epoch readback
+   (~8 s/epoch bulk-read RTT on this tunnel) doesn't pay on this link. Set
+   BENCH_MODE=recycle to measure the deferred pool.
 3. Closed-loop load for peak throughput; then open-loop at ~70% of that for
    honest latency percentiles at a stated offered rate.
 4. ALWAYS prints the phase breakdown (queue/preproc/h2d/compute/postproc),
@@ -78,7 +81,9 @@ def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
         host="127.0.0.1",
         port=int(os.environ.get("BENCH_PORT", 18321)),
         decode_threads=4,
-        decode_inline=True,  # 1-core host: skip the executor hop
+        # 1-core dev host: the executor hop only adds latency. Set
+        # BENCH_DECODE_INLINE=0 on hosts with real CPU parallelism.
+        decode_inline=bool(int(os.environ.get("BENCH_DECODE_INLINE", "1"))),
         startup_canary=False,
         compilation_cache_dir=os.path.join(
             os.path.dirname(os.path.abspath(__file__)), ".jaxcache"),
@@ -107,34 +112,25 @@ def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
     return state, cfg
 
 
-async def run_server_and_load(state, cfg, payload: bytes, ctype: str,
-                              duration: float, warmup: float,
-                              concurrency: int, rate: float | None) -> dict:
-    from aiohttp import web
+async def run_load(cfg, payload: bytes, ctype: str, duration: float,
+                   warmup: float, concurrency: int, rate: float | None) -> dict:
+    """Drive the (already running) server with the out-of-process loadgen."""
+    import tempfile
 
-    from tpuserve.server import make_app
-
-    app = make_app(state)
-    runner = web.AppRunner(app, access_log=None)
-    await runner.setup()
-    site = web.TCPSite(runner, cfg.host, cfg.port)
-    await site.start()
+    with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+        f.write(payload)
+        payload_path = f.name
+    args = [
+        sys.executable, "-m", "tpuserve", "bench",
+        "--url", f"http://{cfg.host}:{cfg.port}",
+        "--model", "resnet50", "--verb", "classify",
+        "--duration", str(duration), "--warmup", str(warmup),
+        "--concurrency", str(concurrency),
+        "--payload", payload_path, "--content-type", ctype,
+    ]
+    if rate:
+        args += ["--rate", str(rate)]
     try:
-        import tempfile
-
-        with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
-            f.write(payload)
-            payload_path = f.name
-        args = [
-            sys.executable, "-m", "tpuserve", "bench",
-            "--url", f"http://{cfg.host}:{cfg.port}",
-            "--model", "resnet50", "--verb", "classify",
-            "--duration", str(duration), "--warmup", str(warmup),
-            "--concurrency", str(concurrency),
-            "--payload", payload_path, "--content-type", ctype,
-        ]
-        if rate:
-            args += ["--rate", str(rate)]
         proc = await asyncio.create_subprocess_exec(
             *args,
             stdout=asyncio.subprocess.PIPE,
@@ -142,10 +138,9 @@ async def run_server_and_load(state, cfg, payload: bytes, ctype: str,
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
         out, _ = await proc.communicate()
-        os.unlink(payload_path)
         return json.loads(out.decode())
     finally:
-        await runner.cleanup()
+        os.unlink(payload_path)
 
 
 def print_breakdown(state, header: str) -> None:
@@ -166,7 +161,7 @@ def print_breakdown(state, header: str) -> None:
 
 def main() -> int:
     t_all = time.time()
-    mode = os.environ.get("BENCH_MODE", "recycle")
+    mode = os.environ.get("BENCH_MODE", "direct")
     wire_format = os.environ.get("BENCH_WIRE_FORMAT", "yuv420")
     wire = int(env_f("BENCH_WIRE", 160))
     buckets = [int(b) for b in os.environ.get("BENCH_BUCKETS", "128,256").split(",")]
@@ -197,16 +192,29 @@ def main() -> int:
     print(f"# payload: {len(payload)}-byte {wire}x{wire} {ctype}", file=sys.stderr)
 
     async def run() -> tuple[dict, dict | None]:
-        closed = await run_server_and_load(
-            state, cfg, payload, ctype, duration, warmup, concurrency, None)
-        print(f"# closed-loop: {closed}", file=sys.stderr)
-        open_res = None
-        rate = env_f("BENCH_OPEN_RATE", 0.0) or round(0.7 * closed["throughput_per_s"])
-        if rate >= 1:
-            open_res = await run_server_and_load(
-                state, cfg, payload, ctype, min(duration, 15), 3, concurrency, rate)
-            print(f"# open-loop @ {rate}/s: {open_res}", file=sys.stderr)
-        return closed, open_res
+        # ONE server lifecycle for both load phases: app cleanup tears down
+        # the model state, so the server must outlive every loadgen run.
+        from aiohttp import web
+
+        from tpuserve.server import make_app
+
+        runner = web.AppRunner(make_app(state), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, cfg.host, cfg.port)
+        await site.start()
+        try:
+            closed = await run_load(
+                cfg, payload, ctype, duration, warmup, concurrency, None)
+            print(f"# closed-loop: {closed}", file=sys.stderr)
+            open_res = None
+            rate = env_f("BENCH_OPEN_RATE", 0.0) or round(0.7 * closed["throughput_per_s"])
+            if rate >= 1:
+                open_res = await run_load(
+                    cfg, payload, ctype, min(duration, 15), 3, concurrency, rate)
+                print(f"# open-loop @ {rate}/s: {open_res}", file=sys.stderr)
+            return closed, open_res
+        finally:
+            await runner.cleanup()
 
     closed, open_res = asyncio.run(run())
     print_breakdown(state, f"mode={mode}")

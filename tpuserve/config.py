@@ -101,6 +101,10 @@ class ServerConfig:
     models: list[ModelConfig] = field(default_factory=list)
     # Host-side decode threadpool size.
     decode_threads: int = 8
+    # Decode request bodies inline on the event loop instead of hopping to
+    # the threadpool. On a single-core host the executor hop only adds
+    # latency; leave False when real CPU parallelism exists.
+    decode_inline: bool = False
     # jax.profiler.start_server port; 0 disables.
     profiler_port: int = 0
     # Directory for the persistent XLA compilation cache ("" disables).

@@ -166,6 +166,10 @@ def _worker_run(mcfg, cache_dir, conn, batch_shm_name, slot_bytes, cap_rows) -> 
                 dev_batch = jax.tree_util.tree_map(jax.device_put, views,
                                                    exe.batch_sharding)
                 jax.block_until_ready(dev_batch)  # slot no longer referenced
+                # Release the shm views NOW: a lingering exported pointer
+                # makes batch_shm.close() raise BufferError at retirement,
+                # killing the worker with results still on device.
+                del views
                 out = exe.compiled(params, dev_batch)
                 acc = appends[bucket](acc, jax.tree_util.tree_flatten(out)[0],
                                       jnp.int32(off))
@@ -186,6 +190,7 @@ def _worker_run(mcfg, cache_dir, conn, batch_shm_name, slot_bytes, cap_rows) -> 
                     flat[:] = h.reshape(-1).view(np.uint8)
                     shapes.append((h.shape, str(h.dtype), offb))
                     offb += h.nbytes
+                del flat  # exported pointer would break results_shm.close()
                 conn.send({"op": "results", "shm": results_shm.name,
                            "shapes": shapes,
                            "treedef": pickle.dumps(out_treedef),

@@ -141,9 +141,13 @@ async def handle_predict(request: web.Request) -> web.Response:
 
     body = await request.read()
     ctype = request.content_type or ""
-    loop = asyncio.get_running_loop()
     try:
-        item = await loop.run_in_executor(state.pool, model.host_decode, body, ctype)
+        if state.cfg.decode_inline:
+            item = model.host_decode(body, ctype)
+        else:
+            loop = asyncio.get_running_loop()
+            item = await loop.run_in_executor(
+                state.pool, model.host_decode, body, ctype)
     except Exception as e:
         metrics.counter(f"bad_requests_total{{model={name}}}").inc()
         return _err(400, f"could not decode request: {e}")
