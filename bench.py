@@ -37,9 +37,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
-import subprocess
 import sys
-import textwrap
 import time
 
 TARGET_V5E8_IMG_S = 12_000.0
@@ -52,25 +50,19 @@ def env_f(name: str, default: float) -> float:
 
 def measure_link_rate_mbps() -> float:
     """Real sustained H2D rate, measured in a virgin subprocess: buffered
-    writes + one dependent read = wall-clock truth."""
-    code = textwrap.dedent("""
-        import time, json, numpy as np, jax, jax.numpy as jnp
-        mb, iters = 16, 5
-        arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
-        t0 = time.perf_counter()
-        devs = [jax.device_put(arr) for _ in range(iters)]
-        jax.block_until_ready(devs)
-        int(jnp.sum(devs[-1][:8].astype(jnp.int32)))  # force drain
-        rate = (mb << 20) * iters / (time.perf_counter() - t0) / 1e6  # decimal MB/s
-        print(json.dumps({"mbps": rate}))
-    """)
+    writes + one dependent read = wall-clock truth (shared probe source:
+    tpuserve.bench.probes)."""
+    from tpuserve.bench.probes import measure_h2d_mbps
+
     try:
-        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                              text=True, timeout=600, cwd=os.path.dirname(os.path.abspath(__file__)))
-        return round(json.loads(proc.stdout.strip().splitlines()[-1])["mbps"], 1)
+        r = measure_h2d_mbps("virgin", cwd=os.path.dirname(os.path.abspath(__file__)))
     except Exception as e:  # noqa: BLE001
-        print(f"# link probe failed ({e}); ceiling math unavailable", file=sys.stderr)
-        return 0.0
+        r = {"error": str(e)}
+    if "mbps" in r:
+        return round(r["mbps"], 1)
+    print(f"# link probe failed ({r.get('error')}); ceiling math unavailable",
+          file=sys.stderr)
+    return 0.0
 
 
 def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
@@ -94,7 +86,11 @@ def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
                 batch_buckets=buckets,
                 deadline_ms=env_f("BENCH_DEADLINE_MS", 100.0),
                 dtype="bfloat16",
-                parallelism="sharded" if mode != "direct" else "single",
+                # Always shard over the data axis: on one chip this equals
+                # single-device serving, and on a v5e-8 it uses every chip —
+                # the vs_baseline math scales the target by len(jax.devices()),
+                # so the served path must scale with it too.
+                parallelism="sharded",
                 request_timeout_ms=60_000.0,
                 max_inflight=4,
                 wire_size=wire,

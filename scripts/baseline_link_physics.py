@@ -3,94 +3,76 @@
 ModelConfig.session_mode cite (BASELINE.md "Link physics").
 
 Three numbers, each measured in a fresh subprocess (the tunnel's H2D behavior
-is process-stateful — see below):
+is process-stateful):
 
 1. h2d_virgin_mbps: sustained host->device rate before any D2H read.
-2. h2d_after_d2h_mbps: the same measurement after one device->host readback —
-   on the tunneled dev TPU the relay drops to a fraction of the virgin rate
-   for the life of the process (why session_mode="recycle" exists).
-3. chip_resnet50_img_s: device-resident ResNet-50 bf16 inference rate
-   (batch 256, inputs already on device, one scalar read per batch) — the
-   compute ceiling with zero wire involvement.
+2. h2d_after_d2h_mbps: the same measurement after one device->host readback
+   (r2 claimed a permanent post-D2H slowdown; the r3 re-measurement with fair
+   warm-up did not reproduce it — both probes stay to keep checking).
+3. chip_resnet50: device-resident ResNet-50 bf16 inference rate (batch 256,
+   inputs already on device) — the compute ceiling with zero wire
+   involvement.
+
+The H2D probes come from ``tpuserve.bench.probes`` — the same source bench.py
+uses for its wire-ceiling math, so the two can never disagree.
 
 Prints one JSON line; paste into BASELINE.md.
 """
 
 import json
+import os
 import subprocess
 import sys
 import textwrap
 
-PROBE = textwrap.dedent("""
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpuserve.bench.probes import measure_h2d_mbps  # noqa: E402
+
+CHIP_PROBE = textwrap.dedent("""
     import time, json, numpy as np, jax, jax.numpy as jnp
-    mode = %r
-    mb, iters = 16, 5
-    arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
+    import sys
+    sys.path.insert(0, %r)
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+    cfg = ModelConfig(name="r", family="resnet50", dtype="bfloat16",
+                      batch_buckets=[256])
+    m = build(cfg)
+    params = m.init_params(jax.random.key(0))
+    # Timing caveats on the tunneled dev TPU: block_until_ready returns
+    # before remote execution finishes, and a dependent per-batch scalar
+    # read adds ~190 ms of relay RTT. The honest method is a
+    # device-resident fori_loop of N forwards with a forced dependency
+    # chain between iterations (defeats loop-invariant hoisting), one
+    # scalar read at the end.
+    N = 32
 
-    # Untimed warm-up in EVERY mode: PJRT client init + first-transfer setup
-    # cost seconds on the tunnel and must not land inside one mode's window
-    # (it would make the two H2D rates incomparable).
-    warm = jax.device_put(np.zeros((1024,), np.uint8))
-    jax.block_until_ready(warm)
+    @jax.jit
+    def many(params, x):
+        def body(i, carry):
+            x, acc = carry
+            out = m.forward(params, x)
+            s = out["probs"][0, 0].astype(jnp.float32)
+            x = x + (s * 0).astype(x.dtype)
+            return (x, acc + s)
+        _, acc = jax.lax.fori_loop(0, N, body, (x, jnp.float32(0)))
+        return acc
 
-    def h2d_rate():
-        t0 = time.perf_counter()
-        devs = [jax.device_put(arr) for _ in range(iters)]
-        jax.block_until_ready(devs)
-        int(jnp.sum(devs[-1][:8].astype(jnp.int32)))
-        return (mb << 20) * iters / (time.perf_counter() - t0) / 1e6
-
-    if mode == "virgin":
-        print(json.dumps({"mbps": h2d_rate()}))
-    elif mode == "after_d2h":
-        d = jax.device_put(arr)
-        np.asarray(d)          # one full D2H readback
-        print(json.dumps({"mbps": h2d_rate()}))
-    else:  # chip compute
-        import sys
-        sys.path.insert(0, %r)
-        from tpuserve.config import ModelConfig
-        from tpuserve.models import build
-        cfg = ModelConfig(name="r", family="resnet50", dtype="bfloat16",
-                          batch_buckets=[256])
-        m = build(cfg)
-        params = m.init_params(jax.random.key(0))
-        # Timing caveats on the tunneled dev TPU: block_until_ready returns
-        # before remote execution finishes, and a dependent per-batch scalar
-        # read adds ~190 ms of relay RTT. The honest method is a
-        # device-resident fori_loop of N forwards with a forced dependency
-        # chain between iterations (defeats loop-invariant hoisting), one
-        # scalar read at the end.
-        N = 32
-
-        @jax.jit
-        def many(params, x):
-            def body(i, carry):
-                x, acc = carry
-                out = m.forward(params, x)
-                s = out["probs"][0, 0].astype(jnp.float32)
-                x = x + (s * 0).astype(x.dtype)
-                return (x, acc + s)
-            _, acc = jax.lax.fori_loop(0, N, body, (x, jnp.float32(0)))
-            return acc
-
-        x = jax.device_put(np.random.default_rng(0).integers(
-            0, 255, (256, 256, 256, 3), np.uint8))
-        float(many(params, x))  # compile + warm
-        t0 = time.perf_counter()
-        float(many(params, x))
-        dur = time.perf_counter() - t0
-        print(json.dumps({"img_s": round(256 * N / dur, 1),
-                          "ms_per_batch": round(dur / N * 1e3, 2)}))
+    x = jax.device_put(np.random.default_rng(0).integers(
+        0, 255, (256, 256, 256, 3), np.uint8))
+    float(many(params, x))  # compile + warm
+    t0 = time.perf_counter()
+    float(many(params, x))
+    dur = time.perf_counter() - t0
+    print(json.dumps({"img_s": round(256 * N / dur, 1),
+                      "ms_per_batch": round(dur / N * 1e3, 2)}))
 """)
 
 
-def run(mode: str) -> dict:
-    import os
-
-    code = PROBE % (mode, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                       text=True, timeout=900)
+def run_chip() -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = subprocess.run([sys.executable, "-c", CHIP_PROBE % repo],
+                       capture_output=True, text=True, timeout=900)
     if p.returncode != 0:
         return {"error": p.stderr.strip()[-300:]}
     return json.loads(p.stdout.strip().splitlines()[-1])
@@ -100,9 +82,9 @@ def main() -> int:
     out: dict = {}
     for key, mode in (("h2d_virgin_mbps", "virgin"),
                       ("h2d_after_d2h_mbps", "after_d2h")):
-        r = run(mode)
+        r = measure_h2d_mbps(mode, timeout=900)
         out[key] = round(r["mbps"], 1) if "mbps" in r else r  # keep error dicts
-    out["chip_resnet50"] = run("chip")
+    out["chip_resnet50"] = run_chip()
     print(json.dumps(out))
     return int(any(isinstance(v, dict) and "error" in v for v in out.values()))
 

@@ -79,6 +79,20 @@ def test_vocab_file_roundtrip(tmp_path):
 
 # -- model + bucketing --------------------------------------------------------
 
+def test_full_size_matches_published_figures():
+    """BERT-base with the standard 30,522-token vocab is ~110M params."""
+    import jax
+    import numpy as np
+
+    from tpuserve.config import ModelConfig
+
+    m = build(ModelConfig(name="b", family="bert", dtype="float32",
+                          num_classes=2, options={"vocab_size": 30522}))
+    p = jax.eval_shape(m.init_params, jax.random.key(0))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+    assert 105e6 < n < 115e6, n
+
+
 @pytest.fixture(scope="module")
 def served():
     """Tiny BERT behind the real runtime (module-scoped: compiles 4 buckets)."""

@@ -105,6 +105,22 @@ def test_full_size_matches_published_figures():
     assert m.latent == 64
 
 
+def test_orbax_roundtrip_preserves_images(sd_model, tmp_path):
+    """SD params survive an orbax save/load (the production startup path)
+    and regenerate the identical image."""
+    from tpuserve import savedmodel
+
+    m, params, fwd = sd_model
+    path = str(tmp_path / "ckpt")
+    savedmodel.save_orbax(path, params)
+    m2 = build(sd_cfg(weights=path))
+    restored = m2.load_params()
+    item = m.host_decode(b'{"prompt": "same", "seed": 11}', "application/json")
+    a = np.asarray(fwd(params, m.assemble([item], (1,)))["image"])
+    b = np.asarray(jax.jit(m2.forward)(restored, m2.assemble([item], (1,)))["image"])
+    np.testing.assert_array_equal(a, b)
+
+
 def test_http_generate_end_to_end():
     from aiohttp.test_utils import TestClient, TestServer
 

@@ -1,0 +1,58 @@
+"""Link-physics probes shared by bench.py and scripts/baseline_link_physics.py
+(BASELINE.md "Link physics").
+
+The dev tunnel's H2D behavior is process-stateful and its timing semantics
+are subtle (block_until_ready returns early; only a dependent read reveals
+the sustained rate), so every probe runs in a fresh subprocess from ONE
+source of truth here — the MiB-vs-MB unit bug of r3 had to be fixed in two
+copies of this code; never again.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+
+H2D_PROBE_SRC = textwrap.dedent("""
+    import time, json, numpy as np, jax, jax.numpy as jnp
+    mode = %r
+    mb, iters = 16, 5
+    arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
+
+    # Untimed warm-up in EVERY mode: PJRT client init + first-transfer setup
+    # cost seconds on the tunnel and must not land inside one mode's window.
+    warm = jax.device_put(np.zeros((1024,), np.uint8))
+    jax.block_until_ready(warm)
+
+    def h2d_rate():
+        t0 = time.perf_counter()
+        devs = [jax.device_put(arr) for _ in range(iters)]
+        jax.block_until_ready(devs)
+        int(jnp.sum(devs[-1][:8].astype(jnp.int32)))  # dependent read: truth
+        return (mb << 20) * iters / (time.perf_counter() - t0) / 1e6  # MB/s
+
+    if mode == "after_d2h":
+        d = jax.device_put(arr)
+        np.asarray(d)          # one full D2H readback first
+    print(json.dumps({"mbps": h2d_rate()}))
+""")
+
+
+def measure_h2d_mbps(mode: str = "virgin", timeout: float = 600.0,
+                     cwd: str | None = None) -> dict:
+    """Run the H2D probe in a fresh subprocess; mode 'virgin' | 'after_d2h'.
+
+    Returns {"mbps": float} or {"error": str}.
+    """
+    proc = subprocess.run(
+        [sys.executable, "-c", H2D_PROBE_SRC % mode],
+        capture_output=True, text=True, timeout=timeout, cwd=cwd,
+    )
+    if proc.returncode != 0:
+        return {"error": proc.stderr.strip()[-300:]}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"unparseable probe output: {e}"}

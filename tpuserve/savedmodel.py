@@ -104,13 +104,20 @@ def extract_saved_model_variables(path: str) -> dict[str, np.ndarray]:
     out: dict[str, np.ndarray] = {}
     try:
         loaded = tf.saved_model.load(path)
+        variables = list(getattr(loaded, "variables", None) or ())
         semantic: dict[str, np.ndarray] = {}
-        for v in getattr(loaded, "variables", None) or ():
+        for v in variables:
             semantic[v.name.split(":")[0]] = np.asarray(v.numpy())
-        # Commit only a complete read: a mid-loop failure must not hand a
-        # truncated dict to import_tf_variables when the checkpoint reader
-        # below could produce the full set.
-        out = semantic
+        # Commit only a complete AND collision-free read: a mid-loop failure
+        # or duplicate names (legal in TF for subclassed models) must not
+        # hand a truncated dict to import_tf_variables when the checkpoint
+        # reader below could produce the full set.
+        if len(semantic) == len(variables):
+            out = semantic
+        elif variables:
+            log.warning(
+                "SavedModel %s has %d variables but only %d unique names; "
+                "using checkpoint reader", path, len(variables), len(semantic))
     except Exception:  # noqa: BLE001 — fall through to the checkpoint reader
         log.warning("tf.saved_model.load failed for %s; using checkpoint reader", path)
     if out:
