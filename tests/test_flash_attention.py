@@ -1,0 +1,104 @@
+"""Pallas fused blockwise attention (SURVEY.md §7 M8): parity with the dense
+reference in interpret mode on CPU, padding-bias semantics, block clamping,
+and the BERT "attention=flash" option end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.ops.flash_attention import flash_attention
+from tpuserve.ops.ring_attention import dense_attention
+
+
+def rand_qkv(rng, b=2, s=256, h=4, d=64):
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.normal(size=(b, s, h, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+def test_matches_dense_reference(rng):
+    q, k, v = rand_qkv(rng)
+    out = np.asarray(flash_attention(q, k, v))
+    ref = np.asarray(dense_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, atol=2e-6)
+
+
+def test_padding_bias_matches_and_masks(rng):
+    q, k, v = rand_qkv(rng)
+    mask = np.ones((2, 256), np.float32)
+    mask[:, 200:] = 0.0
+    bias = jnp.asarray((1.0 - mask) * -1e9)
+    out = np.asarray(flash_attention(q, k, v, bias))
+    ref = np.asarray(dense_attention(q, k, v, bias[:, None, None, :]))
+    np.testing.assert_allclose(out, ref, atol=2e-6)
+    # Masked keys must not influence the output at all: perturbing them
+    # changes nothing.
+    k2 = k.at[:, 200:].set(0.0)
+    v2 = v.at[:, 200:].set(0.0)
+    out2 = np.asarray(flash_attention(q, k2, v2, bias))
+    np.testing.assert_allclose(out, out2, atol=2e-6)
+
+
+def test_block_clamp_small_sequences(rng):
+    """Seq 64 < default block 128: blocks clamp instead of erroring."""
+    q, k, v = rand_qkv(rng, s=64)
+    out = np.asarray(flash_attention(q, k, v))
+    ref = np.asarray(dense_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, atol=2e-6)
+
+
+def test_non_power_of_two_seq_clamps_to_divisor(rng):
+    """192 isn't a multiple of 128: blocks clamp to gcd (64) and still match."""
+    q, k, v = rand_qkv(rng, s=192)
+    out = np.asarray(flash_attention(q, k, v))
+    ref = np.asarray(dense_attention(q, k, v))
+    np.testing.assert_allclose(out, ref, atol=2e-6)
+
+
+def test_unalignable_seq_rejected(rng):
+    q, k, v = rand_qkv(rng, s=96)  # gcd(64, 96) = 32 ok; gcd(36, 96) = 12 bad
+    with pytest.raises(ValueError, match="TPU lowering rejects"):
+        flash_attention(q, k, v, block_q=36)
+
+
+def test_bf16_inputs(rng):
+    q, k, v = (x.astype(jnp.bfloat16) for x in rand_qkv(rng, s=128))
+    raw = flash_attention(q, k, v)
+    assert raw.dtype == jnp.bfloat16  # out_shape follows q.dtype
+    ref = np.asarray(dense_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32),
+        v.astype(jnp.float32)))
+    np.testing.assert_allclose(np.asarray(raw).astype(np.float32), ref, atol=2e-2)
+
+
+def test_bert_flash_option_matches_dense():
+    """cfg.options['attention']='flash' serves identical logits (same params)."""
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    def cfg(attn):
+        return ModelConfig(
+            name="b", family="bert", dtype="float32", num_classes=4,
+            batch_buckets=[2], seq_buckets=[64],
+            options={"layers": 2, "d_model": 64, "heads": 2, "d_ff": 128,
+                     "vocab_size": 512, "attention": attn})
+
+    dense = build(cfg("dense"))
+    flash = build(cfg("flash"))
+    params = dense.init_params(jax.random.key(0))
+    item = dense.host_decode(b'{"text": "flash attention parity"}',
+                             "application/json")
+    batch = dense.assemble([item, item], (2, 64))
+    o_d = np.asarray(jax.jit(dense.forward)(params, batch)["probs"])
+    o_f = np.asarray(jax.jit(flash.forward)(params, batch)["probs"])
+    np.testing.assert_allclose(o_f, o_d, atol=1e-5)
+
+
+def test_bert_rejects_unknown_attention_option():
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    with pytest.raises(ValueError, match="dense.*flash"):
+        build(ModelConfig(name="b", family="bert",
+                          options={"attention": "Flash"}))

@@ -42,15 +42,24 @@ class BertBlock(nn.Module):
     heads: int
     d_ff: int
     dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"  # "dense" | "flash" (Pallas fused kernel)
 
     @nn.compact
     def __call__(self, x, mask_bias):
         # Post-LN (original BERT): sublayer -> add -> LayerNorm. Masking is an
         # explicit additive bias inside attention_fn so the semantics stay
         # bucket-invariant (padded keys get -1e9 before the f32 softmax).
+        if self.attention_impl == "flash":
+            from tpuserve.ops.flash_attention import flash_attention
+
+            # mask_bias is (B, 1, 1, S) additive; flash takes per-key (B, S).
+            fn = lambda q, k, v, **kw: flash_attention(  # noqa: E731
+                q, k, v, mask_bias[:, 0, 0, :])
+        else:
+            fn = lambda q, k, v, **kw: _masked_attention(q, k, v, mask_bias)  # noqa: E731
         attn = nn.MultiHeadDotProductAttention(
             num_heads=self.heads, dtype=self.dtype, deterministic=True,
-            attention_fn=lambda q, k, v, **kw: _masked_attention(q, k, v, mask_bias),
+            attention_fn=fn,
             name="attn")
         x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + attn(x))
         h = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
@@ -77,6 +86,7 @@ class BertClassifier(nn.Module):
     max_seq: int
     num_classes: int
     dtype: Any = jnp.bfloat16
+    attention_impl: str = "dense"
 
     @nn.compact
     def __call__(self, ids, mask):
@@ -88,6 +98,7 @@ class BertClassifier(nn.Module):
         mask_bias = (1.0 - mask.astype(jnp.float32))[:, None, None, :] * -1e9
         for i in range(self.layers):
             x = BertBlock(self.heads, self.d_ff, dtype=self.dtype,
+                          attention_impl=self.attention_impl,
                           name=f"layer{i}")(x, mask_bias)
         cls = x[:, 0, :]
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=self.dtype, name="pooler")(cls))
@@ -98,6 +109,19 @@ class BertServing(ServingModel):
     def __init__(self, cfg: ModelConfig) -> None:
         super().__init__(cfg)
         opt = cfg.options
+        attention = str(opt.get("attention", "dense"))
+        if attention not in ("dense", "flash"):
+            raise ValueError(
+                f"options.attention must be 'dense' or 'flash', got {attention!r}")
+        if (attention == "flash" and cfg.parallelism == "sharded"
+                and jax.default_backend() == "tpu" and len(jax.devices()) > 1):
+            # Mosaic kernels can't be auto-partitioned by a multi-device jit
+            # (jax tpu_custom_call raises NotImplementedError at compile);
+            # fail at build time with guidance instead of at server startup.
+            raise ValueError(
+                "options.attention='flash' requires parallelism='replica' or "
+                "'single' on a multi-chip mesh (Pallas kernels are not "
+                "auto-partitioned under a sharded jit)")
         self.dtype = jnp.dtype(cfg.dtype)
         self.max_seq = max(cfg.seq_buckets)
         vocab_file = opt.get("vocab_file")
@@ -115,6 +139,9 @@ class BertServing(ServingModel):
             max_seq=self.max_seq,
             num_classes=cfg.num_classes,
             dtype=self.dtype,
+            # "flash" routes attention through the Pallas fused kernel
+            # (tpuserve.ops.flash_attention); "dense" is the XLA einsum path.
+            attention_impl=attention,
         )
         self.top_k = min(5, cfg.num_classes)
 
@@ -123,7 +150,12 @@ class BertServing(ServingModel):
         s = min(self.cfg.seq_buckets)
         ids = jnp.zeros((1, s), jnp.int32)
         mask = jnp.ones((1, s), jnp.int32)
-        return self.module.init(rng, ids, mask)
+        # Init through the dense-attention twin: the attention impl doesn't
+        # change the param tree, and init runs on the host CPU (runtime pins
+        # it there), where the compiled Pallas kernel can't execute.
+        init_module = (self.module.clone(attention_impl="dense")
+                       if self.module.attention_impl != "dense" else self.module)
+        return init_module.init(rng, ids, mask)
 
     # -- shapes --------------------------------------------------------------
     def buckets(self) -> list[tuple]:

@@ -5,6 +5,10 @@
   ``jax.lax.ppermute`` while each device accumulates its queries' output with
   an online (streaming) softmax. Memory per device is O(seq/devices), enabling
   contexts far beyond one chip's HBM.
+- ``flash_attention`` — the single-device realization of the same recurrence
+  as a fused Pallas TPU kernel: K/V stream through VMEM in blocks, the score
+  matrix never touches HBM. Used by BERT via ``options.attention = "flash"``.
 """
 
+from tpuserve.ops.flash_attention import flash_attention  # noqa: F401
 from tpuserve.ops.ring_attention import dense_attention, ring_attention  # noqa: F401

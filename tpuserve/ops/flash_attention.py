@@ -1,0 +1,148 @@
+"""Fused blockwise (flash) attention as a Pallas TPU kernel (SURVEY.md §7 M8).
+
+Why a hand kernel here and nowhere else: attention is the one serving op
+where XLA's fusion genuinely leaves HBM bandwidth on the table — dense
+attention materializes the (Sq, Sk) score matrix to HBM twice (scores out,
+softmax back in). This kernel keeps the whole online-softmax recurrence in
+VMEM: for each query tile, K/V stream through the MXU in ``block_k`` tiles
+while the running max ``m``, normalizer ``l``, and f32 accumulator live in
+VMEM scratch — O(S) memory instead of O(S^2), one HBM write per output
+tile. It is the single-device realization of the same recurrence
+``tpuserve.ops.ring_attention`` runs *across* chips (there the blocks arrive
+over ICI via ppermute; here they arrive from HBM via the BlockSpec pipeline).
+
+Kernel shape: grid = (B*H, Sq/block_q, Sk/block_k). The TPU grid executes
+the innermost dimension sequentially, so the k-block axis lives in the GRID
+(the BlockSpec pipeline double-buffers the K/V tiles from HBM) and the
+online-softmax state persists in scratch across k iterations — no in-kernel
+dynamic slicing, which Mosaic rejects for some tile offsets. State is
+initialized at ki == 0 and the output tile is written once at the last ki.
+
+Interface matches the rest of the stack: (B, S, H, D) layout, optional
+additive per-key bias (B, S) — exactly what BERT's padding mask lowers to.
+Padded keys get -1e9 bias => exp underflows to 0 => they contribute nothing
+to ``l`` or ``acc``; a row with at least one live key (BERT always has
+[CLS]) never divides by zero.
+
+CPU/test story: ``pallas_call(interpret=True)`` runs the kernel in the
+Pallas interpreter, so the same code is unit-tested on the CI's fake-device
+CPU mesh and compiled for real on TPU (``interpret=None`` auto-detects from
+the effective default device, honoring ``jax.default_device(cpu)`` blocks
+like the runtime's CPU-pinned param init).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+               m_ref, l_ref, acc_ref, *, scale: float):
+    """One (query tile, key tile) grid cell; state carried in VMEM scratch."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale           # (bq, D)
+    k_blk = k_ref[0].astype(jnp.float32)               # (bk, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(                           # (bq, bk) on the MXU
+        q, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    s = s + bias_ref[0, 0, 0][None, :]
+
+    m_prev = m_ref[:, :1]                              # (bq, 1)
+    l_prev = l_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    bias: jax.Array | None = None, *,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None) -> jax.Array:
+    """Blockwise fused attention, (B, S, H, D) in/out.
+
+    ``bias``: optional additive per-key scores, (B, Sk) — e.g. a padding
+    mask's (1 - mask) * -1e9. Block sizes clamp to divisors of the sequence
+    lengths (exact for power-of-two-aligned buckets like {64, 128, 256, 512};
+    192/320-style buckets fall back to 64-row blocks).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # Clamp blocks to divisors of the sequence lengths (gcd keeps the common
+    # power-of-two alignment: 192 -> 64, 320 -> 64). TPU lowering needs tile
+    # rows divisible by 8 unless the block spans the whole axis.
+    block_q = math.gcd(min(block_q, sq), sq)
+    block_k = math.gcd(min(block_k, sk), sk)
+    for name, blk, size in (("query", block_q, sq), ("key", block_k, sk)):
+        if blk != size and blk % 8:
+            raise ValueError(
+                f"seq_{name} {size} only admits a {blk}-row {name} block, "
+                f"which the TPU lowering rejects; use a multiple of 8")
+    if interpret is None:
+        # The effective platform, honoring `with jax.default_device(cpu)`
+        # (the runtime pins param init there): default_backend() alone would
+        # still say 'tpu' and compile the TPU kernel for a CPU trace.
+        dev = jax.config.jax_default_device
+        platform = getattr(dev, "platform", None) or jax.default_backend()
+        interpret = platform != "tpu"
+    if bias is None:
+        bias = jnp.zeros((b, sk), jnp.float32)
+
+    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head). Bias is
+    # pre-split into k blocks, (B, nk, 1, block_k), so every BlockSpec's last
+    # two dims equal the array's (the TPU divisible-or-whole rule) and the
+    # kernel never slices dynamically.
+    nk = sk // block_k
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    biasf = bias.astype(jnp.float32).reshape(b, nk, 1, block_k)
+
+    kernel = functools.partial(_fa_kernel, scale=d ** -0.5)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, 1, 1, block_k),
+                         lambda bh, qi, ki, h=h: (bh // h, ki, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),   # normalizer l
+            pltpu.VMEM((block_q, d), jnp.float32),     # weighted accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, biasf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
