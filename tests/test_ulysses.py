@@ -1,0 +1,100 @@
+"""Ulysses attention (tpuserve.ops.ulysses) on the 8-fake-device mesh.
+
+Same correctness bar as ring attention (tests/test_ring.py): the all-to-all
+head-resharded result must match dense single-device attention, with and
+without key padding, under combined dp+sp sharding, and must reject head
+counts the seq axis can't deal out.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuserve.ops import dense_attention, ulysses_attention
+from tpuserve.parallel import make_mesh
+from tpuserve.parallel.mesh import MeshPlan
+
+
+def _qkv(rng, b=2, s=16, h=4, d=8):
+    q = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, h, d)).astype(np.float32)
+    return jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+
+@pytest.fixture
+def mesh():
+    # 8 devices -> dp=2, tp=2, sp=2: all axes live, like the ring tests.
+    return make_mesh(MeshPlan(tp=2, sp=2))
+
+
+def test_matches_dense(mesh, rng):
+    q, k, v = _qkv(rng)
+    out = ulysses_attention(q, k, v, mesh)
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_matches_dense_with_key_padding(mesh, rng):
+    q, k, v = _qkv(rng)
+    pad = np.zeros((2, 16), np.float32)
+    pad[:, 12:] = -1e9
+    out = ulysses_attention(q, k, v, mesh, key_padding=jnp.asarray(pad))
+    ref = dense_attention(q, k, v, bias=jnp.asarray(pad)[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_dp_plus_sp_spec(mesh, rng):
+    q, k, v = _qkv(rng)
+    spec = P("data", "seq", None, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh, spec=spec))(q, k, v)
+    ref = dense_attention(*_qkv(np.random.default_rng(0)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_tp_heads_through_ulysses(mesh, rng):
+    """Heads sharded on "model" AND dealt over "seq": both divisions hold."""
+    q, k, v = _qkv(rng, h=8)  # 8 heads / tp=2 = 4 local, / sp=2 = 2 per deal
+    spec = P("data", "seq", "model", None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh, spec=spec))(q, k, v)
+    ref = dense_attention(*_qkv(np.random.default_rng(0), h=8))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_bf16_dtype_preserved(mesh, rng):
+    """Contract shared with ring_attention: out.dtype == q.dtype."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in _qkv(rng))
+    out = ulysses_attention(q, k, v, mesh)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out).astype(np.float32),
+                               np.asarray(ref), atol=2e-2)
+
+
+def test_output_stays_seq_sharded(mesh, rng):
+    q, k, v = _qkv(rng)
+    spec = P(None, "seq", None, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh)
+    out = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh, spec=spec),
+                  out_shardings=sh)(q, k, v)
+    assert out.sharding.spec == spec
+
+
+def test_indivisible_heads_rejected(mesh, rng):
+    q, k, v = _qkv(rng, h=3)  # 3 heads over sp=2: cannot deal
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh)
+
+
+def test_bad_spec_rejected(mesh, rng):
+    q, k, v = _qkv(rng)
+    with pytest.raises(ValueError, match="seq dim"):
+        ulysses_attention(q, k, v, mesh, spec=P("seq", None, None, None))

@@ -5,6 +5,11 @@
   ``jax.lax.ppermute`` while each device accumulates its queries' output with
   an online (streaming) softmax. Memory per device is O(seq/devices), enabling
   contexts far beyond one chip's HBM.
+- ``ulysses_attention`` — the alternative sequence-parallel scheme: one
+  all-to-all deals heads across the seq axis so each device dense-attends its
+  head slice over the full sequence, then an inverse all-to-all restores seq
+  sharding. Lower step latency than the ring for short/medium sequences; the
+  ring wins on memory for very long ones.
 - ``flash_attention`` — the single-device realization of the same recurrence
   as a fused Pallas TPU kernel: K/V stream through VMEM in blocks, the score
   matrix never touches HBM. Used by BERT via ``options.attention = "flash"``.
@@ -12,3 +17,4 @@
 
 from tpuserve.ops.flash_attention import flash_attention  # noqa: F401
 from tpuserve.ops.ring_attention import dense_attention, ring_attention  # noqa: F401
+from tpuserve.ops.ulysses import ulysses_attention  # noqa: F401

@@ -11,8 +11,9 @@ matmuls) over ICI.
 The model is a compact pre-LN transformer encoder LM trained with masked-token
 cross-entropy via optax.adamw. Everything is shape-static and scans-free at
 this size; jax.checkpoint on the block stack trades FLOPs for HBM when
-layers/seq grow. With ``TrainConfig.ring_attention=True`` the blocks use
-``tpuserve.ops.ring_attention`` over the mesh's "seq" axis instead of dense
+layers/seq grow. With ``TrainConfig.seq_attention`` set to "ring" or
+"ulysses" the blocks use ``tpuserve.ops.ring_attention`` /
+``tpuserve.ops.ulysses`` over the mesh's "seq" axis instead of dense
 attention, so the dry run exercises real sequence parallelism.
 """
 
@@ -44,37 +45,42 @@ class TrainConfig:
     max_seq: int = 32
     lr: float = 1e-3
     remat: bool = False
-    # Sequence-parallel attention: rotate K/V over the mesh "seq" axis via
-    # tpuserve.ops.ring_attention instead of dense attention.
-    ring_attention: bool = False
+    # Sequence-parallel attention over the mesh "seq" axis: "dense" (no SP),
+    # "ring" (K/V ppermute rotation, tpuserve.ops.ring_attention), or
+    # "ulysses" (head all-to-all, tpuserve.ops.ulysses).
+    seq_attention: str = "dense"
 
 
 class Block(nn.Module):
     cfg: TrainConfig
     dtype: Any = jnp.float32
-    mesh: Any = None  # required when cfg.ring_attention
+    mesh: Any = None  # required when cfg.seq_attention != "dense"
 
     @nn.compact
     def __call__(self, x):
         c = self.cfg
         attention_fn = nn.dot_product_attention
-        if c.ring_attention:
-            from tpuserve.ops import ring_attention
+        if c.seq_attention != "dense":
+            from tpuserve.ops import ring_attention, ulysses_attention
 
+            if c.seq_attention not in ("ring", "ulysses"):
+                raise ValueError(f"unknown seq_attention {c.seq_attention!r}")
             if self.mesh is None:
-                raise ValueError("TrainConfig.ring_attention=True requires "
-                                 "passing mesh= to the module")
-            # Keep heads tensor-parallel through the ring when tp divides them;
-            # otherwise replicate heads (still seq- and data-parallel).
+                raise ValueError(f"TrainConfig.seq_attention={c.seq_attention!r} "
+                                 "requires passing mesh= to the module")
+            sp_attn = ring_attention if c.seq_attention == "ring" else ulysses_attention
+            # Keep heads tensor-parallel when tp divides them; otherwise
+            # replicate heads (still seq- and data-parallel). Ulysses further
+            # needs the local heads divisible by sp (validated in the op).
             head_axis = "model" if c.n_heads % self.mesh.shape["model"] == 0 else None
             spec = P("data", "seq", head_axis, None)
 
             def attention_fn(query, key, value, mask=None, **_kw):  # noqa: ANN001
                 if mask is not None:
                     raise NotImplementedError(
-                        "ring-attention train path takes no attention mask; "
+                        "sequence-parallel train path takes no attention mask; "
                         "pass padding via loss masking instead")
-                return ring_attention(query, key, value, self.mesh, spec=spec)
+                return sp_attn(query, key, value, self.mesh, spec=spec)
 
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(num_heads=c.n_heads, dtype=self.dtype,
@@ -194,7 +200,7 @@ def dryrun(devices: list, steps: int = 1) -> float:
     n = len(devices)
     plan = mesh_plan_for(n)
     mesh = make_mesh(plan, devices=devices)
-    cfg = TrainConfig(ring_attention=plan.sp > 1)
+    cfg = TrainConfig(seq_attention="ring" if plan.sp > 1 else "dense")
     model, params, tx, opt_state, shardings = make_train_state(mesh, cfg)
     step, _ = make_train_step(model, tx, mesh, shardings)
     batch_size = max(4, 2 * mesh.shape["data"])
