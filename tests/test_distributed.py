@@ -1,0 +1,123 @@
+"""Multi-host seam: host-major mesh grid, DistributedConfig, real
+single-process jax.distributed.initialize (SURVEY.md §5 "Distributed comm
+backend").
+
+Real multi-host needs multiple processes; what IS testable here: the grid
+layout math on stub devices with fake process_index values (the property that
+tp/sp blocks never cross a host), config plumbing, the no-op path, and — in a
+subprocess, so this process's backend stays untouched — an actual
+jax.distributed.initialize handshake with num_processes=1.
+"""
+
+import os
+import subprocess
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpuserve.config import DistributedConfig, load_config
+from tpuserve.parallel import host_major_grid, init_distributed, make_mesh
+from tpuserve.parallel.mesh import MeshPlan
+
+
+@dataclass(frozen=True)
+class FakeDev:
+    id: int
+    process_index: int
+
+
+def _devs(n_hosts: int, per_host: int) -> list[FakeDev]:
+    return [FakeDev(id=h * per_host + i, process_index=h)
+            for h in range(n_hosts) for i in range(per_host)]
+
+
+def test_grid_single_host_is_plain_reshape():
+    devs = _devs(1, 8)
+    grid = host_major_grid(devs, dp=2, tp=2, sp=2)
+    assert grid.shape == (2, 2, 2)
+    assert [d.id for d in grid.reshape(-1)] == list(range(8))
+
+
+def test_grid_tp_sp_blocks_stay_on_one_host():
+    # 4 hosts x 4 devices, tp=2 sp=2 -> each dp row must be one host's block.
+    devs = _devs(4, 4)
+    grid = host_major_grid(devs, dp=4, tp=2, sp=2)
+    for dp_row in grid:
+        hosts = {d.process_index for d in dp_row.reshape(-1)}
+        assert len(hosts) == 1, f"tp/sp block crosses hosts: {hosts}"
+
+
+def test_grid_data_axis_is_host_major():
+    devs = _devs(2, 8)  # 2 hosts x 8 -> dp=4 with tp=2 sp=2
+    grid = host_major_grid(devs, dp=4, tp=2, sp=2)
+    row_hosts = [grid[i, 0, 0].process_index for i in range(4)]
+    assert row_hosts == sorted(row_hosts), "data axis must walk hosts in rank order"
+
+
+def test_grid_rejects_tp_sp_crossing_dcn():
+    devs = _devs(4, 2)  # 2 devices per host cannot hold tp*sp=4
+    with pytest.raises(ValueError, match="must divide each host"):
+        host_major_grid(devs, dp=2, tp=2, sp=2)
+
+
+def test_grid_rejects_ragged_hosts():
+    devs = _devs(2, 4) + [FakeDev(id=99, process_index=2)]
+    with pytest.raises(ValueError, match="unequal"):
+        host_major_grid(devs, dp=9, tp=1, sp=1)
+
+
+def test_make_mesh_still_builds_on_real_fake_devices():
+    # The host-major path is the identity for single-host: existing meshes
+    # (8 fake CPU devices, all process_index 0) keep working.
+    mesh = make_mesh(MeshPlan(tp=2, sp=2))
+    assert dict(mesh.shape) == {"data": 2, "model": 2, "seq": 2}
+
+
+def test_init_distributed_disabled_is_noop():
+    assert init_distributed(DistributedConfig()) is False
+
+
+def test_distributed_config_from_toml(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text(
+        'port = 9999\n\n[distributed]\ncoordinator_address = "10.0.0.1:8476"\n'
+        "num_processes = 4\nprocess_id = 2\n"
+    )
+    cfg = load_config(str(p))
+    assert cfg.distributed.coordinator_address == "10.0.0.1:8476"
+    assert cfg.distributed.num_processes == 4
+    assert cfg.distributed.process_id == 2
+    # default stays disabled
+    assert load_config(None).distributed.coordinator_address == ""
+
+
+def test_real_initialize_single_process_subprocess():
+    """jax.distributed.initialize actually handshakes (1-process cluster).
+
+    Runs in a subprocess because initialize() must precede backend init and
+    this test process's backend is already up.
+    """
+    port = 18000 + os.getpid() % 2000  # avoid collisions across parallel runs
+    code = (
+        "import jax\n"
+        "from tpuserve.config import DistributedConfig\n"
+        "from tpuserve.parallel import init_distributed, process_info\n"
+        f"cfg = DistributedConfig(coordinator_address='127.0.0.1:{port}',"
+        " num_processes=1, process_id=0)\n"
+        "assert init_distributed(cfg) is True\n"
+        "info = process_info()\n"
+        "assert info['process_count'] == 1, info\n"
+        "assert info['global_devices'] >= 1, info\n"
+        "print('DIST_OK')\n"
+    )
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert "DIST_OK" in out.stdout, out.stderr[-2000:]

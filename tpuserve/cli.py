@@ -82,9 +82,13 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "warmup":
         from tpuserve.config import default_config, load_config
+        from tpuserve.parallel import init_distributed
         from tpuserve.server import ServerState
 
         cfg = load_config(args.config, args.overrides) if args.config else default_config()
+        # Same ordering rule as serve(): on a pod, the cache entries are only
+        # useful if they're compiled against the global topology.
+        init_distributed(cfg.distributed)
         state = ServerState(cfg)
         state.build()
         print(json.dumps({n: rt.describe() for n, rt in state.runtimes.items()}, indent=2))

@@ -93,11 +93,34 @@ class ModelConfig:
 
 
 @dataclass
+class DistributedConfig:
+    """Multi-host (multi-process) JAX runtime initialization (SURVEY.md §5
+    "Distributed communication backend").
+
+    On TPU pods each host runs one tpuserve process; setting
+    ``coordinator_address`` to process 0's ``host:port`` makes startup call
+    ``jax.distributed.initialize`` BEFORE any device use, after which
+    ``jax.devices()`` is the global device set and the serving mesh spans
+    hosts — data-parallel over DCN, tensor/sequence axes within each host's
+    ICI domain (see ``tpuserve.parallel.mesh``). Leave empty for single-host.
+    """
+
+    # "host:port" of the process-0 coordinator; "" disables distributed init.
+    coordinator_address: str = ""
+    # Total process (host) count; -1 = take from the TPU/cluster environment.
+    num_processes: int = -1
+    # This process's rank; -1 = take from the TPU/cluster environment.
+    process_id: int = -1
+
+
+@dataclass
 class ServerConfig:
     """Top-level server configuration."""
 
     host: str = "0.0.0.0"
     port: int = 8000
+    # Multi-host runtime init; defaults to single-host (disabled).
+    distributed: DistributedConfig = field(default_factory=DistributedConfig)
     models: list[ModelConfig] = field(default_factory=list)
     # Host-side decode threadpool size.
     decode_threads: int = 8
@@ -143,8 +166,11 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
             raw = tomllib.load(f)
 
     model_dicts = raw.pop("model", [])
+    dist_dict = raw.pop("distributed", None)
     cfg: ServerConfig = _build(ServerConfig, raw)
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
+    if dist_dict is not None:
+        cfg.distributed = _build(DistributedConfig, dist_dict)
 
     for ov in overrides or []:
         _apply_override(cfg, ov)

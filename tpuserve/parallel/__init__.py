@@ -8,15 +8,19 @@ sharding layout (SURVEY.md §5 "Distributed communication backend").
 
 Submodules:
 
-- ``mesh``       — mesh construction (dp/tp/sp axes, multi-host seam)
-- ``partition``  — regex partition rules -> PartitionSpec pytrees
+- ``mesh``        — mesh construction (dp/tp/sp axes, host-major multi-host grid)
+- ``partition``   — regex partition rules -> PartitionSpec pytrees
+- ``distributed`` — jax.distributed.initialize seam for multi-host pods
 
 Sequence parallelism for long contexts lives at the op level:
-``tpuserve.ops.ring_attention`` (shard_map + ppermute over the "seq" axis).
+``tpuserve.ops.ring_attention`` (shard_map + ppermute over the "seq" axis)
+and ``tpuserve.ops.ulysses`` (head all-to-all).
 """
 
+from tpuserve.parallel.distributed import init_distributed, process_info  # noqa: F401
 from tpuserve.parallel.mesh import (  # noqa: F401
     MeshPlan,
+    host_major_grid,
     make_mesh,
     batch_sharding,
     replicated_sharding,

@@ -48,18 +48,48 @@ class MeshPlan:
         return dp, tp, sp
 
 
+def host_major_grid(devices: list, dp: int, tp: int, sp: int) -> np.ndarray:
+    """(dp, tp, sp) device grid with every (tp, sp) block inside one host.
+
+    Multi-host layout rule (SURVEY.md §5 "Distributed comm backend"): the
+    data axis is host-major — hosts ordered by ``process_index``, each host's
+    devices filling whole dp rows — so tensor- and sequence-parallel
+    collectives stay on a host's ICI domain and only data-parallel traffic
+    crosses DCN. Single-host input (all ``process_index`` equal) reduces to a
+    plain reshape, preserving device order.
+    """
+    hosts: dict[int, list] = {}
+    for d in devices:
+        hosts.setdefault(getattr(d, "process_index", 0), []).append(d)
+    counts = {len(v) for v in hosts.values()}
+    if len(counts) != 1:
+        raise ValueError("hosts contribute unequal device counts: "
+                         f"{ {h: len(v) for h, v in sorted(hosts.items())} }")
+    if counts.pop() % (tp * sp) != 0:
+        raise ValueError(
+            f"tp*sp={tp * sp} must divide each host's device count "
+            f"({len(devices) // len(hosts)}): tensor/sequence axes must not "
+            "cross DCN")
+    ordered = [d for _, host in sorted(hosts.items()) for d in host]
+    grid = np.empty(len(ordered), dtype=object)
+    grid[:] = ordered
+    return grid.reshape(dp, tp, sp)
+
+
 def make_mesh(plan: MeshPlan | None = None, devices: list | None = None) -> Mesh:
     """Build a Mesh with axes (data, model[, seq]).
 
     Axes of size 1 for model/seq are still materialized so PartitionSpecs
     mentioning them remain valid regardless of configuration; XLA treats a
-    size-1 axis as free.
+    size-1 axis as free. Works unchanged from 1 local chip to a multi-host
+    pod: the grid is host-major (see ``host_major_grid``), which for a
+    single host is the identity layout.
     """
     plan = plan or MeshPlan()
     devices = devices if devices is not None else jax.devices()
     dp, tp, sp = plan.resolve(len(devices))
-    grid = np.asarray(devices).reshape(dp, tp, sp)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+    return Mesh(host_major_grid(devices, dp, tp, sp),
+                (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -198,8 +198,12 @@ async def handle_metrics(request: web.Request) -> web.Response:
 
 
 async def handle_stats(request: web.Request) -> web.Response:
+    from tpuserve.parallel import process_info
+
     state: ServerState = request.app[STATE_KEY]
-    return web.json_response(state.metrics.summary())
+    out = state.metrics.summary()
+    out["process"] = process_info()
+    return web.json_response(out)
 
 
 async def handle_trace(request: web.Request) -> web.Response:
@@ -262,6 +266,11 @@ def make_app(state: ServerState) -> web.Application:
 def serve(cfg: ServerConfig) -> None:
     """Blocking entry point: build models, compile, serve."""
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # Multi-host: must happen before ServerState.build() touches a device —
+    # backend init freezes the process's view of the topology.
+    from tpuserve.parallel import init_distributed
+
+    init_distributed(cfg.distributed)
     state = ServerState(cfg)
     state.build()
     app = make_app(state)
