@@ -99,3 +99,18 @@ def test_mobilenet_http_end_to_end():
 
     loop.run_until_complete(go())
     loop.close()
+
+
+def test_preproc_norm_overridable_per_model():
+    """Keras-MobileNetV3 weights expect x/127.5 - 1: preproc_mean/std options
+    must reach the fused device preproc (default stays ImageNet stats)."""
+    m_default = build(mnv3_cfg())
+    m_keras = build(mnv3_cfg(options={"preproc_mean": [0.5, 0.5, 0.5],
+                                      "preproc_std": [0.5, 0.5, 0.5]}))
+    assert m_default.norm_mean == (0.485, 0.456, 0.406)
+    assert m_keras.norm_mean == (0.5, 0.5, 0.5)
+    batch = np.full((1, 64, 64, 3), 255, np.uint8)
+    x_def = np.asarray(m_default.prepare_batch(batch))
+    x_ker = np.asarray(m_keras.prepare_batch(batch))
+    np.testing.assert_allclose(x_ker, 1.0, atol=1e-6)  # (1.0 - 0.5) / 0.5
+    assert not np.allclose(x_def, x_ker)

@@ -32,6 +32,8 @@ def _randomize(model: "tf.keras.Model") -> None:
     for w in model.weights:
         shape = tuple(w.shape)
         name = getattr(w, "path", getattr(w, "name", ""))
+        if "float" not in str(w.dtype):  # e.g. dropout seed_generator_state
+            continue
         if "moving_variance" in name:
             w.assign(rng.uniform(0.5, 1.5, shape).astype(np.float32))
         elif "gamma" in name:
@@ -87,6 +89,53 @@ def test_tf_and_jax_logits_agree(keras_savedmodel):
     # f32 end-to-end: relative 1e-4-grade agreement (SURVEY §4-4). The
     # randomized deep net amplifies activations to logit scale ~1e3, so the
     # budget is relative; measured max diff is ~1e-3 at that scale (1e-6 rel).
+    np.testing.assert_allclose(y_jax, y_tf, rtol=1e-4, atol=5e-3)
+    assert (y_jax.argmax(-1) == y_tf.argmax(-1)).all()
+
+
+@pytest.fixture(scope="module")
+def mnv3_savedmodel(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("mnv3") / "sm")
+    # include_preprocessing=False: compare the networks on identical float
+    # inputs (the default bakes a /127.5 - 1 rescaling into the Keras graph;
+    # our serving equivalent lives in the device preproc stage, not the net).
+    keras_model = tf.keras.applications.MobileNetV3Large(
+        weights=None, classes=1000, classifier_activation=None,
+        include_preprocessing=False)
+    _randomize(keras_model)
+    keras_model.export(path, verbose=False)
+    return keras_model, path
+
+
+def mnv3_cfg(weights: str | None = None) -> ModelConfig:
+    return ModelConfig(name="mnv3", family="mobilenetv3", dtype="float32",
+                       num_classes=1000, weights=weights)
+
+
+def test_mnv3_imported_tree_matches_init_structure(mnv3_savedmodel):
+    _, path = mnv3_savedmodel
+    model = build(mnv3_cfg(weights=path))
+    imported = model.load_params()
+    want = jax.eval_shape(model.init_params, jax.random.key(0))
+    assert (jax.tree_util.tree_structure(imported)
+            == jax.tree_util.tree_structure(want))
+    for got, exp in zip(jax.tree_util.tree_leaves(imported),
+                        jax.tree_util.tree_leaves(want)):
+        assert got.shape == exp.shape
+
+
+def test_mnv3_tf_and_jax_logits_agree(mnv3_savedmodel):
+    """Depthwise (H,W,C,1)->(H,W,1,C) and SE/post-pool-1x1 mappings are exact
+    (SURVEY.md §7 hard part 3 names depthwise layouts as the fiddly case)."""
+    keras_model, path = mnv3_savedmodel
+    model = build(mnv3_cfg(weights=path))
+    params = model.load_params()
+
+    x = np.random.default_rng(0).uniform(-1, 1, (2, 224, 224, 3)).astype(np.float32)
+    y_tf = keras_model(x, training=False).numpy()
+    y_jax = np.asarray(jax.jit(model.module.apply)(params, x))
+
+    assert y_tf.shape == y_jax.shape == (2, 1000)
     np.testing.assert_allclose(y_jax, y_tf, rtol=1e-4, atol=5e-3)
     assert (y_jax.argmax(-1) == y_tf.argmax(-1)).all()
 

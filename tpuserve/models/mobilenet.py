@@ -141,6 +141,76 @@ class MobileNetV3Serving(ImageClassifierServing):
         return MobileNetV3Large(num_classes=cfg.num_classes,
                                 dtype=jnp.dtype(cfg.dtype))
 
+    def import_tf_variables(self, flat):
+        """Keras-applications MobileNetV3Large names/layouts -> this pytree.
+
+        Source scheme (``tf.keras.applications.MobileNetV3Large``): stem
+        ``conv``/``conv_bn``; block i as ``expanded_conv[_i]_{expand,
+        depthwise, project}[_bn]`` (block 0 has no ``_0`` suffix and no
+        expand conv) with squeeze-excite at ``..._squeeze_excite_conv``
+        (reduce) / ``..._squeeze_excite_conv_1`` (expand), both biased; head
+        ``conv_1``/``conv_1_bn`` then the post-pool ``conv_2`` and ``logits``
+        1x1 convs. BN eps is 1e-3 on both sides (the module default), and
+        Keras' pad-then-VALID stride-2 depthwise equals SAME padding at this
+        model's even feature sizes, so no option knobs are needed.
+
+        Layout translations (SURVEY.md §7 hard part 3): depthwise kernels are
+        (H, W, C, 1) in Keras vs Flax's (H, W, 1, C) for
+        ``feature_group_count=C`` — a transpose of the last two dims; the
+        post-pool 1x1 convs ``conv_2``/``logits`` become our Dense layers by
+        dropping the spatial 1x1 dims. Plain convs are bias-free on both
+        sides (no BN-fold needed, unlike ResNet50's import).
+        """
+        import numpy as np
+
+        f = {k.split(":")[0]: np.asarray(v) for k, v in flat.items()}
+
+        def conv(name):
+            return {"kernel": f[f"{name}/kernel"]}
+
+        def bn(name):
+            return (
+                {"scale": f[f"{name}/gamma"], "bias": f[f"{name}/beta"]},
+                {"mean": f[f"{name}/moving_mean"],
+                 "var": f[f"{name}/moving_variance"]},
+            )
+
+        def dense_from_1x1(name):
+            k = f[f"{name}/kernel"]  # (1, 1, in, out)
+            return {"kernel": k.reshape(k.shape[2], k.shape[3]),
+                    "bias": f[f"{name}/bias"]}
+
+        params: dict = {}
+        stats: dict = {}
+        params["stem"] = conv("conv")
+        params["bn_stem"], stats["bn_stem"] = bn("conv_bn")
+        for i, (_k, _exp, _out, use_se, _hs, _s) in enumerate(self.module.blocks):
+            tfp = "expanded_conv" if i == 0 else f"expanded_conv_{i}"
+            p: dict = {}
+            st: dict = {}
+            if f"{tfp}_expand/kernel" in f:
+                p["expand"] = conv(f"{tfp}_expand")
+                p["bn_expand"], st["bn_expand"] = bn(f"{tfp}_expand_bn")
+            dw = f[f"{tfp}_depthwise/kernel"]  # (H, W, C, 1)
+            p["depthwise"] = {"kernel": dw.transpose(0, 1, 3, 2)}
+            p["bn_dw"], st["bn_dw"] = bn(f"{tfp}_depthwise_bn")
+            if use_se:
+                p["se"] = {
+                    "reduce": {"kernel": f[f"{tfp}_squeeze_excite_conv/kernel"],
+                               "bias": f[f"{tfp}_squeeze_excite_conv/bias"]},
+                    "expand": {"kernel": f[f"{tfp}_squeeze_excite_conv_1/kernel"],
+                               "bias": f[f"{tfp}_squeeze_excite_conv_1/bias"]},
+                }
+            p["project"] = conv(f"{tfp}_project")
+            p["bn_project"], st["bn_project"] = bn(f"{tfp}_project_bn")
+            params[f"block{i}"] = p
+            stats[f"block{i}"] = st
+        params["head_conv"] = conv("conv_1")
+        params["bn_head"], stats["bn_head"] = bn("conv_1_bn")
+        params["pre_logits"] = dense_from_1x1("conv_2")
+        params["classifier"] = dense_from_1x1("logits")
+        return {"params": params, "batch_stats": stats}
+
 
 def create(cfg: ModelConfig) -> MobileNetV3Serving:
     return MobileNetV3Serving(cfg)

@@ -30,6 +30,12 @@ class ImageClassifierServing(ServingModel):
         self.dtype = jnp.dtype(cfg.dtype)
         self.module = self.make_module(cfg)
         self.top_k = min(self.TOP_K, cfg.num_classes)
+        # Normalization the network was trained with, as (mean, std) applied
+        # after the /255 scale. Default torchvision-style ImageNet stats;
+        # override per model in options — e.g. Keras MobileNetV3 weights
+        # expect x/127.5 - 1, i.e. mean = std = (0.5, 0.5, 0.5).
+        self.norm_mean = tuple(cfg.options.get("preproc_mean", preproc.IMAGENET_MEAN))
+        self.norm_std = tuple(cfg.options.get("preproc_std", preproc.IMAGENET_STD))
 
     def make_module(self, cfg: ModelConfig):
         raise NotImplementedError
@@ -56,8 +62,10 @@ class ImageClassifierServing(ServingModel):
         if self.cfg.wire_format == "yuv420":
             y, u, v = batch
             return preproc.device_prepare_images_yuv420(
-                y, u, v, self.cfg.image_size, dtype=self.dtype)
-        return preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype)
+                y, u, v, self.cfg.image_size, dtype=self.dtype,
+                mean=self.norm_mean, std=self.norm_std)
+        return preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype,
+                                             mean=self.norm_mean, std=self.norm_std)
 
     def forward(self, params: Any, batch: Any) -> dict:
         x = self.prepare_batch(batch)
