@@ -140,6 +140,79 @@ def test_mnv3_tf_and_jax_logits_agree(mnv3_savedmodel):
     assert (y_jax.argmax(-1) == y_tf.argmax(-1)).all()
 
 
+@pytest.fixture(scope="module")
+def bert_savedmodel(tmp_path_factory):
+    transformers = pytest.importorskip("transformers")
+    path = str(tmp_path_factory.mktemp("bert") / "sm")
+    # The TF model's vocab must equal the serving module's (tokenizer-derived;
+    # the synthetic dev vocab has a floor of ~275 entries) — exactly as real
+    # BERT artifacts pair a vocab.txt with a matching embedding table.
+    vocab_size = build(bert_cfg()).module.vocab_size
+    cfg = transformers.BertConfig(
+        vocab_size=vocab_size, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, num_labels=3,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    tf_model = transformers.TFBertForSequenceClassification(cfg)
+    tf_model(np.zeros((1, 8), np.int32), training=False)  # build variables
+    rng = np.random.default_rng(11)
+    for w in tf_model.weights:
+        if "float" in str(w.dtype):
+            w.assign((rng.standard_normal(tuple(w.shape)) * 0.05).astype(np.float32))
+    tf.saved_model.save(tf_model, path)
+    return tf_model, path
+
+
+def bert_cfg(weights: str | None = None) -> ModelConfig:
+    return ModelConfig(
+        name="bert", family="bert", dtype="float32", num_classes=3,
+        weights=weights, seq_buckets=[64],
+        options={"layers": 2, "d_model": 32, "heads": 2, "d_ff": 64,
+                 "vocab_size": 128})
+
+
+def test_bert_imported_tree_matches_init_structure(bert_savedmodel):
+    _, path = bert_savedmodel
+    model = build(bert_cfg(weights=path))
+    imported = model.load_params()
+    want = jax.eval_shape(model.init_params, jax.random.key(0))
+    assert (jax.tree_util.tree_structure(imported)
+            == jax.tree_util.tree_structure(want))
+    for got, exp in zip(jax.tree_util.tree_leaves(imported),
+                        jax.tree_util.tree_leaves(want)):
+        assert got.shape == exp.shape
+
+
+def test_bert_tf_and_jax_logits_agree(bert_savedmodel):
+    """HF (d,d)->(d,H,HD) attention reshapes, token-type fold, and LN-eps
+    faithfulness: logits parity incl. a padded-lane attention mask."""
+    tf_model, path = bert_savedmodel
+    model = build(bert_cfg(weights=path))
+    params = model.load_params()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 16)).astype(np.int32)
+    mask = np.ones((2, 16), np.int32)
+    mask[1, 10:] = 0  # one padded row: exercises the additive bias path
+    y_tf = tf_model(ids, attention_mask=mask, training=False).logits.numpy()
+    y_jax = np.asarray(jax.jit(model.module.apply)(params, ids, mask))
+
+    assert y_tf.shape == y_jax.shape == (2, 3)
+    np.testing.assert_allclose(y_jax, y_tf, rtol=1e-4, atol=1e-5)
+    assert (y_jax.argmax(-1) == y_tf.argmax(-1)).all()
+
+
+def test_bert_rejects_vocab_mismatch(bert_savedmodel):
+    """A checkpoint whose vocab differs from the serving tokenizer's must
+    fail at load time, not serve silently-wrong logits."""
+    _, path = bert_savedmodel
+    cfg = bert_cfg(weights=path)
+    cfg.options = {**cfg.options, "vocab_size": 8192}  # bigger synthetic vocab
+    model = build(cfg)
+    with pytest.raises(ValueError, match="vocab"):
+        model.load_params()
+
+
 def test_bf16_serving_close_to_tf(keras_savedmodel):
     """The production dtype (bf16 convs) stays within the SURVEY bf16 budget
     (<=1e-2) of the TF-f32 reference."""

@@ -43,6 +43,7 @@ class BertBlock(nn.Module):
     d_ff: int
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"  # "dense" | "flash" (Pallas fused kernel)
+    ln_eps: float = 1e-12  # original BERT value; keeps imported weights exact
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -61,11 +62,15 @@ class BertBlock(nn.Module):
             num_heads=self.heads, dtype=self.dtype, deterministic=True,
             attention_fn=fn,
             name="attn")
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x + attn(x))
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=self.ln_eps, dtype=self.dtype, name=name)
+        x = ln("ln_attn")(x + attn(x))
         h = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
-        h = nn.gelu(h)
+        # Exact (erf) GELU, matching BERT; the tanh approximation drifts
+        # ~1e-3 on imported weights.
+        h = nn.gelu(h, approximate=False)
         h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
-        return nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x + h)
+        return ln("ln_mlp")(x + h)
 
 
 def _masked_attention(q, k, v, mask_bias):
@@ -87,6 +92,7 @@ class BertClassifier(nn.Module):
     num_classes: int
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
+    ln_eps: float = 1e-12
 
     @nn.compact
     def __call__(self, ids, mask):
@@ -94,11 +100,12 @@ class BertClassifier(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (self.max_seq, self.d_model))
         x = x + pos[None, : ids.shape[1], :].astype(self.dtype)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_embed")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype, name="ln_embed")(x)
         mask_bias = (1.0 - mask.astype(jnp.float32))[:, None, None, :] * -1e9
         for i in range(self.layers):
             x = BertBlock(self.heads, self.d_ff, dtype=self.dtype,
                           attention_impl=self.attention_impl,
+                          ln_eps=self.ln_eps,
                           name=f"layer{i}")(x, mask_bias)
         cls = x[:, 0, :]
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=self.dtype, name="pooler")(cls))
@@ -144,6 +151,101 @@ class BertServing(ServingModel):
             attention_impl=attention,
         )
         self.top_k = min(5, cfg.num_classes)
+
+    def import_tf_variables(self, flat: dict) -> Any:
+        """HF transformers TFBert(ForSequenceClassification) -> this pytree.
+
+        Source scheme (``transformers.TFBertForSequenceClassification``
+        SavedModel): ``<root>/bert/embeddings/{word_embeddings/weight,
+        position_embeddings/embeddings, token_type_embeddings/embeddings,
+        LayerNorm}``, per-layer ``bert/encoder/layer_._{i}/{attention/self/
+        query|key|value, attention/output/dense, attention/output/LayerNorm,
+        intermediate/dense, output/dense, output/LayerNorm}``, then
+        ``bert/pooler/dense`` and ``<root>/classifier``.
+
+        Layout translations: HF fuses heads into (d, d) attention kernels;
+        Flax MHA wants (d, heads, head_dim) for Q/K/V and (heads, head_dim,
+        d) for the out projection — pure reshapes, head-major on both sides.
+        The serving path is single-segment (classify one text), so the
+        token-type table collapses to its segment-0 row, folded into the
+        position embeddings (both are added before the embedding LayerNorm).
+        """
+        m = self.module
+        head_dim = m.d_model // m.heads
+        f: dict[str, np.ndarray] = {}
+        for k, v in flat.items():
+            k = k.split(":")[0]
+            k = k.split("/", 1)[1] if "/" in k else k  # drop the root name
+            f[k] = np.asarray(v)
+
+        emb = "bert/embeddings"
+        words = f[f"{emb}/word_embeddings/weight"]
+        if words.shape[0] != m.vocab_size:
+            raise ValueError(
+                f"imported embedding table has {words.shape[0]} rows but the "
+                f"serving tokenizer implies vocab_size {m.vocab_size}; pair "
+                "the checkpoint with its matching vocab_file")
+        n_cls = f["classifier/kernel"].shape[1]
+        if n_cls != self.cfg.num_classes:
+            raise ValueError(
+                f"imported classifier has {n_cls} classes but cfg.num_classes "
+                f"is {self.cfg.num_classes}")
+        pos = f[f"{emb}/position_embeddings/embeddings"]
+        if pos.shape[0] < self.max_seq:
+            raise ValueError(
+                f"imported position table covers {pos.shape[0]} positions "
+                f"but max seq bucket is {self.max_seq}")
+        pos = pos[: self.max_seq]
+        tt = f.get(f"{emb}/token_type_embeddings/embeddings")
+        if tt is not None:
+            pos = pos + tt[0][None, :]
+
+        params: dict = {
+            "embed": {"embedding": words},
+            "pos_embed": pos,
+            "ln_embed": {"scale": f[f"{emb}/LayerNorm/gamma"],
+                         "bias": f[f"{emb}/LayerNorm/beta"]},
+            "pooler": {"kernel": f["bert/pooler/dense/kernel"],
+                       "bias": f["bert/pooler/dense/bias"]},
+            "classifier": {"kernel": f["classifier/kernel"],
+                           "bias": f["classifier/bias"]},
+        }
+        for i in range(m.layers):
+            lyr = f"bert/encoder/layer_._{i}"
+
+            def qkv(name: str) -> dict:
+                return {
+                    "kernel": f[f"{lyr}/attention/self/{name}/kernel"].reshape(
+                        m.d_model, m.heads, head_dim),
+                    "bias": f[f"{lyr}/attention/self/{name}/bias"].reshape(
+                        m.heads, head_dim),
+                }
+
+            def ln(name: str) -> dict:
+                return {"scale": f[f"{lyr}/{name}/gamma"],
+                        "bias": f[f"{lyr}/{name}/beta"]}
+
+            def dense(name: str) -> dict:
+                return {"kernel": f[f"{lyr}/{name}/kernel"],
+                        "bias": f[f"{lyr}/{name}/bias"]}
+
+            params[f"layer{i}"] = {
+                "attn": {
+                    "query": qkv("query"),
+                    "key": qkv("key"),
+                    "value": qkv("value"),
+                    "out": {
+                        "kernel": f[f"{lyr}/attention/output/dense/kernel"]
+                        .reshape(m.heads, head_dim, m.d_model),
+                        "bias": f[f"{lyr}/attention/output/dense/bias"],
+                    },
+                },
+                "ln_attn": ln("attention/output/LayerNorm"),
+                "mlp_up": dense("intermediate/dense"),
+                "mlp_down": dense("output/dense"),
+                "ln_mlp": ln("output/LayerNorm"),
+            }
+        return {"params": params}
 
     # -- params --------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> Any:
