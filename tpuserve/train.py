@@ -175,6 +175,75 @@ def make_train_step(model, tx, mesh: Mesh, param_shardings):
     ), batch_sharding
 
 
+def save_train_state(path: str, params: Any, opt_state: Any, step: int) -> None:
+    """Checkpoint the full train state (params + optimizer + step) with orbax.
+
+    Arrays are saved from wherever they live — on a sharded mesh each host
+    writes its own shards (orbax is multi-host-aware), so no host ever
+    gathers the full state.
+    """
+    import os
+
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        # force=True: a periodic-checkpoint loop overwrites its stable path.
+        ckptr.save(os.path.abspath(path),
+                   {"params": params, "opt_state": opt_state, "step": step},
+                   force=True)
+        ckptr.wait_until_finished()
+
+
+def restore_train_state(path: str, mesh: Mesh, cfg: TrainConfig):
+    """Resume: restore directly into the mesh's shardings (no host staging).
+
+    The abstract restore target comes from ``jax.eval_shape`` — nothing is
+    materialized on device before the restore, so peak memory is one train
+    state, not two. Each abstract leaf carries its NamedSharding (params from
+    the partition rules; optimizer moments inherit the matching param's
+    sharding by tree-suffix, scalars replicate), so every device reads
+    exactly its own shard from disk. Returns
+    ``(model, params, tx, opt_state, shardings, step)`` ready for
+    ``make_train_step``.
+    """
+    import os
+
+    import orbax.checkpoint as ocp
+
+    model = TransformerLM(cfg, mesh=mesh)
+    tokens = jnp.zeros((mesh.shape["data"], cfg.max_seq), jnp.int32)
+    params_shape = jax.eval_shape(model.init, jax.random.key(0), tokens)["params"]
+    specs = match_partition_rules(TRAIN_PARTITION_RULES, params_shape)
+    shardings = specs_to_shardings(specs, mesh)
+    tx = optax.adamw(cfg.lr)
+    opt_shape = jax.eval_shape(tx.init, params_shape)
+
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    by_suffix = {tuple(str(k) for k in p): s for p, s in flat}
+    replicated = NamedSharding(mesh, P())
+
+    def opt_sharding(path, leaf):
+        """Adam's mu/nu mirror the param tree: match by path suffix."""
+        keys = tuple(str(k) for k in path)
+        for i in range(len(keys)):
+            s = by_suffix.get(keys[i:])
+            if s is not None:
+                return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=s)
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=replicated)
+
+    target = {
+        "params": jax.tree_util.tree_map(
+            lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s),
+            params_shape, shardings),
+        "opt_state": jax.tree_util.tree_map_with_path(opt_sharding, opt_shape),
+        "step": 0,
+    }
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(os.path.abspath(path), target)
+    return (model, restored["params"], tx, restored["opt_state"], shardings,
+            int(restored["step"]))
+
+
 def synthetic_batch(cfg: TrainConfig, batch_size: int, seed: int = 0) -> dict:
     rng = np.random.default_rng(seed)
     tokens = rng.integers(0, cfg.vocab, (batch_size, cfg.max_seq)).astype(np.int32)
