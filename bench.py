@@ -109,7 +109,8 @@ def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
 
 
 async def run_load(cfg, payload: bytes, ctype: str, duration: float,
-                   warmup: float, concurrency: int, rate: float | None) -> dict:
+                   warmup: float, concurrency: int, rate: float | None,
+                   client_batch: int = 0) -> dict:
     """Drive the (already running) server with the out-of-process loadgen."""
     import tempfile
 
@@ -124,6 +125,8 @@ async def run_load(cfg, payload: bytes, ctype: str, duration: float,
         "--concurrency", str(concurrency),
         "--payload", payload_path, "--content-type", ctype,
     ]
+    if client_batch > 1:
+        args += ["--batch", str(client_batch)]
     if rate:
         args += ["--rate", str(rate)]
     try:
@@ -179,13 +182,24 @@ def main() -> int:
     state, cfg = build_state(mode, wire_format, wire, buckets)
     print(f"# build+compile+prewarm took {time.time() - t0:.1f}s", file=sys.stderr)
 
-    from tpuserve.bench.loadgen import synthetic_image_jpeg, synthetic_image_npy
+    from tpuserve.bench.loadgen import (
+        synthetic_image_jpeg,
+        synthetic_image_npy,
+        synthetic_image_npy_batch,
+    )
 
-    if os.environ.get("BENCH_PAYLOAD", "jpeg") == "jpeg":
+    # BENCH_CLIENT_BATCH=N > 1: each POST carries an (N, wire, wire, 3) npy
+    # batch ({"results": [...]} response; throughput counts items). Default
+    # off — the headline number stays the reference-shaped single-image POST.
+    client_batch = int(env_f("BENCH_CLIENT_BATCH", 0))
+    if client_batch > 1:
+        payload, ctype = synthetic_image_npy_batch(wire, client_batch), "application/x-npy"
+    elif os.environ.get("BENCH_PAYLOAD", "jpeg") == "jpeg":
         payload, ctype = synthetic_image_jpeg(wire), "image/jpeg"
     else:
         payload, ctype = synthetic_image_npy(wire), "application/x-npy"
-    print(f"# payload: {len(payload)}-byte {wire}x{wire} {ctype}", file=sys.stderr)
+    print(f"# payload: {len(payload)}-byte {wire}x{wire} {ctype}"
+          + (f" x{client_batch}/POST" if client_batch > 1 else ""), file=sys.stderr)
 
     async def run() -> tuple[dict, dict | None]:
         # ONE server lifecycle for both load phases: app cleanup tears down
@@ -200,13 +214,17 @@ def main() -> int:
         await site.start()
         try:
             closed = await run_load(
-                cfg, payload, ctype, duration, warmup, concurrency, None)
+                cfg, payload, ctype, duration, warmup, concurrency, None,
+                client_batch=client_batch)
             print(f"# closed-loop: {closed}", file=sys.stderr)
             open_res = None
-            rate = env_f("BENCH_OPEN_RATE", 0.0) or round(0.7 * closed["throughput_per_s"])
+            # Open-loop rate is REQUESTS/s; closed throughput counts items.
+            rate = env_f("BENCH_OPEN_RATE", 0.0) or round(
+                0.7 * closed["throughput_per_s"] / max(1, client_batch))
             if rate >= 1:
                 open_res = await run_load(
-                    cfg, payload, ctype, min(duration, 15), 3, concurrency, rate)
+                    cfg, payload, ctype, min(duration, 15), 3, concurrency, rate,
+                    client_batch=client_batch)
                 print(f"# open-loop @ {rate}/s: {open_res}", file=sys.stderr)
             return closed, open_res
         finally:

@@ -190,6 +190,27 @@ def test_bert_http_end_to_end():
                 "/v1/models/bert:classify", data=b"{oops",
                 headers={"Content-Type": "application/json"})
             assert resp.status == 400
+
+            # {"texts": [...]} client batch -> {"results": [...]} in order
+            resp = await client.post(
+                "/v1/models/bert:classify",
+                data=json.dumps({"texts": ["first text", "second one"]}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert resp.status == 200, await resp.text()
+            body = await resp.json()
+            assert len(body["results"]) == 2
+            solo = await client.post(
+                "/v1/models/bert:classify",
+                data=json.dumps({"text": "second one"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert (await solo.json()) == body["results"][1]
+
+            # non-string entries -> 400
+            resp = await client.post(
+                "/v1/models/bert:classify",
+                data=json.dumps({"texts": ["ok", 7]}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert resp.status == 400
         finally:
             await client.close()
 

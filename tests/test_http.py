@@ -156,3 +156,51 @@ def test_index_page(client):
         assert "tpuserve" in await resp.text()
 
     run(go())
+
+
+def test_client_batch_npy(client):
+    """(N, H, W, 3) npy body -> {"results": [N per-item results]}, matching
+    what each image returns individually."""
+    run, c = client
+    rng = np.random.default_rng(5)
+    batch = rng.integers(0, 255, (3, 8, 8, 3), dtype=np.uint8)
+
+    async def go():
+        resp = await c.post("/v1/models/toy:classify", data=npy_bytes(batch),
+                            headers={"Content-Type": "application/x-npy"})
+        assert resp.status == 200, await resp.text()
+        body = await resp.json()
+        assert set(body) == {"results"} and len(body["results"]) == 3
+        # item 1 served alone must answer identically
+        solo = await c.post("/v1/models/toy:classify", data=npy_bytes(batch[1]),
+                            headers={"Content-Type": "application/x-npy"})
+        assert (await solo.json()) == body["results"][1]
+
+    run(go())
+
+
+def test_client_batch_of_one_keeps_batch_shape(client):
+    run, c = client
+    one = np.random.default_rng(6).integers(0, 255, (1, 8, 8, 3), dtype=np.uint8)
+
+    async def go():
+        resp = await c.post("/v1/models/toy:classify", data=npy_bytes(one),
+                            headers={"Content-Type": "application/x-npy"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert set(body) == {"results"} and len(body["results"]) == 1
+
+    run(go())
+
+
+def test_client_batch_over_limit_400(client):
+    run, c = client
+    big = np.zeros((1025, 2, 2, 3), dtype=np.uint8)
+
+    async def go():
+        resp = await c.post("/v1/models/toy:classify", data=npy_bytes(big),
+                            headers={"Content-Type": "application/x-npy"})
+        assert resp.status == 400
+        assert "limit" in (await resp.json())["error"]
+
+    run(go())

@@ -102,3 +102,23 @@ def test_errors_counted(loop):
                  duration_s=0.3, concurrency=2, warmup_s=0.0))
     loop.run_until_complete(server.close())
     assert res.n_ok == 0 and res.n_err > 0
+
+
+def test_items_per_request_scales_throughput():
+    from tpuserve.bench.loadgen import LoadResult
+
+    r = LoadResult(mode="closed", n_ok=10, duration_s=2.0, items_per_request=8)
+    assert r.throughput == 40.0
+    assert r.summary()["items_per_request"] == 8
+    assert "items_per_request" not in LoadResult(n_ok=1, duration_s=1.0).summary()
+
+
+def test_synthetic_batch_payload_shape():
+    import io
+
+    import numpy as np
+
+    from tpuserve.bench.loadgen import synthetic_image_npy_batch
+
+    arr = np.load(io.BytesIO(synthetic_image_npy_batch(16, 4)), allow_pickle=False)
+    assert arr.shape == (4, 16, 16, 3) and arr.dtype == np.uint8

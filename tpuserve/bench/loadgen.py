@@ -37,11 +37,17 @@ class LoadResult:
     n_late: int = 0  # completed after the window closed (excluded above)
     duration_s: float = 0.0  # actual measurement window
     offered_rate: float = 0.0  # open loop only: requests/s issued
+    # Client-side batching: each POST carries this many items (the server's
+    # {"results": [...]} shape). Throughput counts ITEMS; latencies are still
+    # whole-request (the time to answer all items in the POST).
+    items_per_request: int = 1
     latencies_ms: list[float] = field(default_factory=list)
 
     @property
     def throughput(self) -> float:
-        return self.n_ok / self.duration_s if self.duration_s > 0 else 0.0
+        if self.duration_s <= 0:
+            return 0.0
+        return self.n_ok * self.items_per_request / self.duration_s
 
     def summary(self) -> dict:
         out = {
@@ -55,6 +61,8 @@ class LoadResult:
             "p90_ms": round(percentile(self.latencies_ms, 0.9), 3),
             "p99_ms": round(percentile(self.latencies_ms, 0.99), 3),
         }
+        if self.items_per_request != 1:
+            out["items_per_request"] = self.items_per_request
         if self.mode == "open":
             out["offered_rate_per_s"] = round(self.offered_rate, 1)
         return out
@@ -63,6 +71,15 @@ class LoadResult:
 def synthetic_image_npy(edge: int = 256, seed: int = 0) -> bytes:
     rng = np.random.default_rng(seed)
     arr = rng.integers(0, 255, (edge, edge, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def synthetic_image_npy_batch(edge: int = 256, n: int = 8, seed: int = 0) -> bytes:
+    """(n, edge, edge, 3) uint8 npy body: one POST carrying a client batch."""
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 255, (n, edge, edge, 3), dtype=np.uint8)
     buf = io.BytesIO()
     np.save(buf, arr)
     return buf.getvalue()
@@ -108,11 +125,12 @@ async def run_load(
     duration_s: float = 10.0,
     concurrency: int = 64,
     warmup_s: float = 2.0,
+    items_per_request: int = 1,
 ) -> LoadResult:
     """Closed loop: `concurrency` workers, one request in flight each."""
     import aiohttp
 
-    result = LoadResult(mode="closed")
+    result = LoadResult(mode="closed", items_per_request=items_per_request)
     headers = {"Content-Type": content_type}
     now = time.perf_counter()
     record_from = now + warmup_s
@@ -145,6 +163,7 @@ async def run_load_open(
     duration_s: float = 10.0,
     warmup_s: float = 2.0,
     max_inflight: int = 4096,
+    items_per_request: int = 1,
 ) -> LoadResult:
     """Open loop: issue at `rate_per_s` on a fixed clock, independent of
     completions. If the server can't keep up, in-flight grows toward
@@ -155,7 +174,8 @@ async def run_load_open(
 
     if rate_per_s <= 0:
         raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
-    result = LoadResult(mode="open", offered_rate=rate_per_s)
+    result = LoadResult(mode="open", offered_rate=rate_per_s,
+                        items_per_request=items_per_request)
     headers = {"Content-Type": content_type}
     interval = 1.0 / rate_per_s
     now = time.perf_counter()
@@ -200,19 +220,25 @@ async def run_load_open(
 
 
 def run_loadgen_cli(args) -> int:
+    batch = int(getattr(args, "batch", 0) or 0)
     if args.payload:
         with open(args.payload, "rb") as f:
             payload = f.read()
+    elif batch > 1:
+        payload = synthetic_image_npy_batch(n=batch)
     else:
         payload = synthetic_image_npy()
+    items = max(1, batch)
     url = f"{args.url}/v1/models/{args.model}:{args.verb}"
     warmup = getattr(args, "warmup", 2.0)
     rate = getattr(args, "rate", None)
     if rate:
         result = asyncio.run(run_load_open(
-            url, payload, args.content_type, rate, args.duration, warmup))
+            url, payload, args.content_type, rate, args.duration, warmup,
+            items_per_request=items))
     else:
         result = asyncio.run(run_load(
-            url, payload, args.content_type, args.duration, args.concurrency, warmup))
+            url, payload, args.content_type, args.duration, args.concurrency,
+            warmup, items_per_request=items))
     print(json.dumps(result.summary()))
     return 0 if result.n_ok > 0 else 1

@@ -41,6 +41,9 @@ def main(argv: list[str] | None = None) -> int:
                          help="open-loop offered rate (req/s); switches to open-loop mode")
     p_bench.add_argument("--payload", default=None, help="file to POST; default synthetic image")
     p_bench.add_argument("--content-type", default="application/x-npy")
+    p_bench.add_argument("--batch", type=int, default=0,
+                         help="client-side batch: POST (N,H,W,3) npy bodies; "
+                              "throughput counts items")
 
     p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
     p_imp.add_argument("--saved-model", required=True)

@@ -103,6 +103,22 @@ class ServingModel(abc.ABC):
         Runs in the decode threadpool; must touch only its own arguments.
         """
 
+    def host_decode_items(self, payload: bytes, content_type: str) -> tuple[list, bool]:
+        """Decode one request body into (items, is_batch) with a single parse.
+
+        Batched client requests amortize HTTP and host-decode overhead and
+        let one POST fill a whole device bucket. Families opt in by
+        overriding: vision accepts a (N, H, W, 3) uint8 npy tensor, text a
+        {"texts": [...]} JSON list; ``is_batch`` requests answer in the
+        {"results": [...]} shape even for one item. Default: single-item
+        ``host_decode``. Runs in the decode threadpool.
+        """
+        return [self.host_decode(payload, content_type)], False
+
+    # A single POST may not carry more items than one full device batch era;
+    # bounds host memory for the decode stage.
+    MAX_ITEMS_PER_REQUEST = 1024
+
     def canary_item(self) -> Any:
         """A trivial decoded item used by health canaries; default zero image."""
         w = self.cfg.wire_size

@@ -288,13 +288,29 @@ class BertServing(ServingModel):
     # -- host side -----------------------------------------------------------
     def host_decode(self, payload: bytes, content_type: str) -> np.ndarray:
         """Request body -> unpadded int32 token ids (incl. [CLS]/[SEP])."""
-        if content_type.startswith("application/json"):
-            body = json.loads(payload.decode("utf-8"))
-            text = body.get("text")
-            if not isinstance(text, str):
-                raise ValueError('JSON body must contain "text": str')
-        else:
-            text = payload.decode("utf-8")
+        return self.host_decode_items(payload, content_type)[0][0]
+
+    def host_decode_items(self, payload: bytes, content_type: str) -> tuple[list, bool]:
+        """One JSON parse: {"text": str} is single, {"texts": [...]} a batch;
+        non-JSON bodies are one plain-text item."""
+        if not content_type.startswith("application/json"):
+            return [self._encode(payload.decode("utf-8"))], False
+        body = json.loads(payload.decode("utf-8"))
+        texts = body.get("texts")
+        if texts is not None:
+            if not isinstance(texts, list) or not all(isinstance(t, str) for t in texts):
+                raise ValueError('"texts" must be a list of strings')
+            if len(texts) > self.MAX_ITEMS_PER_REQUEST:
+                raise ValueError(
+                    f"batch of {len(texts)} exceeds the per-request limit "
+                    f"({self.MAX_ITEMS_PER_REQUEST})")
+            return [self._encode(t) for t in texts], True
+        text = body.get("text")
+        if not isinstance(text, str):
+            raise ValueError('JSON body must contain "text": str')
+        return [self._encode(text)], False
+
+    def _encode(self, text: str) -> np.ndarray:
         tok = self.tokenizer
         pieces = tok.tokenize(text)  # once; encode() would re-tokenize
         ids = [tok.cls_id] + [tok.vocab.get(t, tok.unk_id) for t in pieces]

@@ -54,6 +54,14 @@ class ToyServing(ServingModel):
 
         return preproc.decode_image(payload, content_type, edge=EDGE)
 
+    def host_decode_items(self, payload: bytes, content_type: str) -> tuple[list, bool]:
+        """npy client batches, sharing the vision probe (one parse)."""
+        if content_type != "application/x-npy":
+            return [self.host_decode(payload, content_type)], False
+        from tpuserve import preproc
+
+        return preproc.decode_npy_items(payload, EDGE, self.MAX_ITEMS_PER_REQUEST)
+
     def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
         return self.format_top_k(outputs, n_valid)
 

@@ -79,6 +79,17 @@ class ImageClassifierServing(ServingModel):
             return preproc.decode_image_yuv420(payload, content_type, self.cfg.wire_size)
         return preproc.decode_image(payload, content_type, edge=self.cfg.wire_size)
 
+    def host_decode_items(self, payload: bytes, content_type: str) -> tuple[list, bool]:
+        """npy bodies parse once: (N, H, W, 3) is a client batch, (H, W, 3)
+        a single item; other content types take the single-image path."""
+        if content_type != "application/x-npy":
+            return [self.host_decode(payload, content_type)], False
+        items, batched = preproc.decode_npy_items(
+            payload, self.cfg.wire_size, self.MAX_ITEMS_PER_REQUEST)
+        if self.cfg.wire_format == "yuv420":
+            items = [preproc.rgb_to_yuv420(a) for a in items]
+        return items, batched
+
     def canary_item(self) -> Any:
         if self.cfg.wire_format == "yuv420":
             w, h = self.cfg.wire_size, self.cfg.wire_size // 2

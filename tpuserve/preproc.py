@@ -44,14 +44,7 @@ def decode_image(payload: bytes, content_type: str = "", edge: int = DECODE_EDGE
     """
     if content_type == "application/x-npy":
         arr = np.load(io.BytesIO(payload), allow_pickle=False)
-        if arr.ndim != 3 or arr.shape[-1] != 3:
-            raise ValueError(f"raw tensor must be (H, W, 3), got {arr.shape}")
-        if arr.dtype != np.uint8:
-            raise ValueError(f"raw tensor must be uint8 (0-255), got {arr.dtype}")
-        img = arr
-        if img.shape[:2] != (edge, edge):
-            img = _resize_uint8(img, edge)
-        return img
+        return decode_image_array(arr, edge)
     from PIL import Image
 
     with Image.open(io.BytesIO(payload)) as im:
@@ -59,6 +52,30 @@ def decode_image(payload: bytes, content_type: str = "", edge: int = DECODE_EDGE
         if im.size != (edge, edge):
             im = im.resize((edge, edge), Image.BILINEAR)
         return np.asarray(im, dtype=np.uint8)
+
+
+def decode_npy_items(payload: bytes, edge: int, max_items: int):
+    """npy body -> (items, is_batch) with ONE parse: a (N, H, W, 3) tensor is
+    a client batch of N, an (H, W, 3) tensor a single item."""
+    arr = np.load(io.BytesIO(payload), allow_pickle=False)
+    if arr.ndim == 4:
+        if arr.shape[0] > max_items:
+            raise ValueError(
+                f"batch of {arr.shape[0]} exceeds the per-request limit ({max_items})")
+        return [decode_image_array(a, edge) for a in arr], True
+    return [decode_image_array(arr, edge)], False
+
+
+def decode_image_array(arr: np.ndarray, edge: int) -> np.ndarray:
+    """In-memory (H, W, 3) uint8 -> (edge, edge, 3) uint8 (shared by the
+    single-image npy body and each element of a batched (N, H, W, 3) body)."""
+    if arr.ndim != 3 or arr.shape[-1] != 3:
+        raise ValueError(f"raw tensor must be (H, W, 3), got {arr.shape}")
+    if arr.dtype != np.uint8:
+        raise ValueError(f"raw tensor must be uint8 (0-255), got {arr.dtype}")
+    if arr.shape[:2] != (edge, edge):
+        arr = _resize_uint8(arr, edge)
+    return arr
 
 
 def _resize_uint8(img: np.ndarray, edge: int) -> np.ndarray:

@@ -141,38 +141,56 @@ async def handle_predict(request: web.Request) -> web.Response:
 
     body = await request.read()
     ctype = request.content_type or ""
+
     try:
+        # (items, is_batch) with one parse; a 1-element client batch still
+        # answers in the {"results": [...]} shape.
         if state.cfg.decode_inline:
-            item = model.host_decode(body, ctype)
+            items, batched = model.host_decode_items(body, ctype)
         else:
             loop = asyncio.get_running_loop()
-            item = await loop.run_in_executor(
-                state.pool, model.host_decode, body, ctype)
+            items, batched = await loop.run_in_executor(
+                state.pool, model.host_decode_items, body, ctype)
+        if not items:
+            raise ValueError("empty batch")
     except Exception as e:
         metrics.counter(f"bad_requests_total{{model={name}}}").inc()
         return _err(400, f"could not decode request: {e}")
 
+    futs = []
     try:
-        fut = state.batchers[name].submit(item, group=model.group_key(item))
+        for item in items:
+            futs.append(state.batchers[name].submit(
+                item, group=model.group_key(item)))
     except QueueFull:
+        for f in futs:
+            f.cancel()
         return _err(429, "queue full, retry later")
     except RuntimeError as e:
         # Batcher stopped/not started: requests racing shutdown get a clean
         # retryable status instead of an unhandled 500.
+        for f in futs:
+            f.cancel()
         return _err(503, f"server not accepting requests: {e}")
 
     try:
         timeout = mcfg.request_timeout_ms / 1e3
-        result = await asyncio.wait_for(fut, timeout=timeout)
+        results = await asyncio.wait_for(asyncio.gather(*futs), timeout=timeout)
     except asyncio.TimeoutError:
-        fut.cancel()
+        for f in futs:
+            f.cancel()
         metrics.counter(f"timeouts_total{{model={name}}}").inc()
         return _err(504, f"request deadline ({mcfg.request_timeout_ms} ms) exceeded")
     except Exception as e:
+        for f in futs:
+            f.cancel()
         return _err(500, f"inference failed: {e}")
 
     total_ms = (time.perf_counter() - t_start) * 1e3
     metrics.observe_phase(name, "total", total_ms)
+    if batched:
+        return web.json_response({"results": list(results)})
+    result = results[0]
     if isinstance(result, bytes):  # e.g. SD PNG output
         return web.Response(body=result, content_type="image/png")
     return web.json_response(result)
