@@ -153,6 +153,65 @@ def test_bad_json_raises(served):
         model.host_decode(b'{"no_text": 1}', "application/json")
 
 
+# -- sequence-parallel serving -----------------------------------------------
+
+def test_ring_attention_serving_matches_dense():
+    """attention="ring" + sp=2 on the sharded 8-device mesh: seq-sharded
+    activations, K/V around the ICI ring, identical logits (incl. padding)."""
+    import jax
+
+    from tpuserve.runtime import build_runtime
+
+    cfg_ring = tiny_cfg(parallelism="sharded", sp=2, batch_buckets=[4],
+                        seq_buckets=[16],
+                        options={**TINY, "attention": "ring"})
+    ring = build(cfg_ring)
+    rt = build_runtime(ring)  # binds the mesh + AOT-compiles the SP forward
+    dense = build(tiny_cfg(batch_buckets=[4], seq_buckets=[16]))
+
+    items = [dense.host_decode(
+        json.dumps({"text": f"sequence parallel serving {i}"}).encode(),
+        "application/json") for i in range(3)]  # 3 of 4 lanes real
+    batch = dense.assemble(items, (4, 16))
+    params = dense.init_params(jax.random.key(0))  # same tree either impl
+    out_ring = rt.run((4, 16), batch)
+    out_dense = jax.jit(dense.forward)(params, batch)
+    # Same params: the runtime loaded its own; rerun ring's forward with
+    # dense's params for the apples-to-apples check.
+    out_ring2 = jax.jit(ring.forward)(params, batch)
+    np.testing.assert_allclose(np.asarray(out_ring2["probs"]),
+                               np.asarray(out_dense["probs"]), atol=1e-5)
+    assert np.asarray(out_ring["probs"]).shape == (4, 4)  # compiled path runs
+
+
+def test_ring_requires_divisible_seq_buckets():
+    with pytest.raises(ValueError, match="divisible"):
+        build(tiny_cfg(parallelism="sharded", sp=4, seq_buckets=[8, 18],
+                       options={**TINY, "attention": "ring"}))
+
+
+def test_ring_rejects_replica_mode():
+    with pytest.raises(ValueError, match="replica"):
+        build(tiny_cfg(parallelism="replica",
+                       options={**TINY, "attention": "ring"}))
+
+
+def test_ring_without_bound_mesh_errors_clearly():
+    import jax
+
+    model = build(tiny_cfg(parallelism="sharded", sp=2, batch_buckets=[4],
+                           seq_buckets=[16], options={**TINY, "attention": "ring"}))
+    params = model.init_params(jax.random.key(0))
+    batch = model.assemble([model.host_decode(b"hello", "text/plain")], (4, 16))
+    with pytest.raises(ValueError, match="bind_mesh"):
+        model.forward(params, batch)
+
+
+def test_nonpositive_sp_rejected_at_config():
+    with pytest.raises(ValueError, match="sp"):
+        tiny_cfg(sp=0)
+
+
 # -- HTTP end-to-end ----------------------------------------------------------
 
 def test_bert_http_end_to_end():

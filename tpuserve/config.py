@@ -67,6 +67,11 @@ class ModelConfig:
     parallelism: str = "sharded"
     # Tensor-parallel axis size carved out of the mesh (1 = TP off).
     tp: int = 1
+    # Sequence-parallel axis size (1 = SP off). With BERT's
+    # options.attention = "ring", activations shard their seq dim over this
+    # axis and attention rotates K/V around the ICI ring — long-context
+    # serving beyond one chip's attention memory.
+    sp: int = 1
     # Model-specific knobs (e.g. SD: num_steps, guidance_scale; detect: score
     # threshold). Kept open-ended on purpose.
     options: dict[str, Any] = field(default_factory=dict)
@@ -90,6 +95,11 @@ class ModelConfig:
     relay_epoch_ms: float = 2000.0
     # recycle mode: per-worker shared-memory batch slots (in-flight batches).
     relay_slots: int = 4
+
+    def __post_init__(self) -> None:
+        if self.tp < 1 or self.sp < 1:
+            raise ValueError(
+                f"tp and sp must be >= 1, got tp={self.tp} sp={self.sp}")
 
 
 @dataclass

@@ -162,6 +162,13 @@ class ServingModel(abc.ABC):
         return _stack_pad(items, b)
 
     # -- parallelism --------------------------------------------------------
+    def bind_mesh(self, mesh: Any) -> None:
+        """Runtime hands the model its serving mesh before params/compile.
+
+        Default no-op. Families whose forward needs mesh-aware ops override —
+        e.g. BERT's ring attention closes over the mesh's "seq" axis.
+        """
+
     def partition_rules(self) -> list[tuple[str, P]]:
         """Ordered (regex, PartitionSpec) rules for params; default replicate."""
         return [(".*", P())]

@@ -42,8 +42,11 @@ class BertBlock(nn.Module):
     heads: int
     d_ff: int
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "dense"  # "dense" | "flash" (Pallas fused kernel)
+    # "dense" (XLA einsum) | "flash" (Pallas fused kernel) | "ring"
+    # (sequence-parallel over the serving mesh's "seq" axis).
+    attention_impl: str = "dense"
     ln_eps: float = 1e-12  # original BERT value; keeps imported weights exact
+    mesh: Any = None  # required for "ring"
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -56,6 +59,23 @@ class BertBlock(nn.Module):
             # mask_bias is (B, 1, 1, S) additive; flash takes per-key (B, S).
             fn = lambda q, k, v, **kw: flash_attention(  # noqa: E731
                 q, k, v, mask_bias[:, 0, 0, :])
+        elif self.attention_impl == "ring":
+            from jax.sharding import PartitionSpec as P
+
+            from tpuserve.ops import ring_attention
+
+            if self.mesh is None:
+                raise ValueError(
+                    "attention='ring' needs the serving mesh: the runtime "
+                    "calls bind_mesh(mesh); do the same before forward")
+            # Activations reshard (batch on "data", seq on "seq") at the
+            # shard_map boundary; K/V then rotate around the ICI ring. Heads
+            # stay tensor-parallel through the ring when tp divides them.
+            head_axis = ("model"
+                         if self.heads % self.mesh.shape["model"] == 0 else None)
+            fn = lambda q, k, v, **kw: ring_attention(  # noqa: E731
+                q, k, v, self.mesh, key_padding=mask_bias[:, 0, 0, :],
+                spec=P("data", "seq", head_axis, None))
         else:
             fn = lambda q, k, v, **kw: _masked_attention(q, k, v, mask_bias)  # noqa: E731
         attn = nn.MultiHeadDotProductAttention(
@@ -93,6 +113,7 @@ class BertClassifier(nn.Module):
     dtype: Any = jnp.bfloat16
     attention_impl: str = "dense"
     ln_eps: float = 1e-12
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, ids, mask):
@@ -105,7 +126,7 @@ class BertClassifier(nn.Module):
         for i in range(self.layers):
             x = BertBlock(self.heads, self.d_ff, dtype=self.dtype,
                           attention_impl=self.attention_impl,
-                          ln_eps=self.ln_eps,
+                          ln_eps=self.ln_eps, mesh=self.mesh,
                           name=f"layer{i}")(x, mask_bias)
         cls = x[:, 0, :]
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=self.dtype, name="pooler")(cls))
@@ -117,9 +138,9 @@ class BertServing(ServingModel):
         super().__init__(cfg)
         opt = cfg.options
         attention = str(opt.get("attention", "dense"))
-        if attention not in ("dense", "flash"):
-            raise ValueError(
-                f"options.attention must be 'dense' or 'flash', got {attention!r}")
+        if attention not in ("dense", "flash", "ring"):
+            raise ValueError("options.attention must be 'dense', 'flash', or "
+                             f"'ring', got {attention!r}")
         if (attention == "flash" and cfg.parallelism == "sharded"
                 and jax.default_backend() == "tpu" and len(jax.devices()) > 1):
             # Mosaic kernels can't be auto-partitioned by a multi-device jit
@@ -129,6 +150,18 @@ class BertServing(ServingModel):
                 "options.attention='flash' requires parallelism='replica' or "
                 "'single' on a multi-chip mesh (Pallas kernels are not "
                 "auto-partitioned under a sharded jit)")
+        if attention == "ring":
+            if cfg.parallelism == "replica":
+                # One shared module can't close over N per-replica meshes;
+                # a ring over a 1-device replica is pointless anyway.
+                raise ValueError(
+                    "options.attention='ring' requires parallelism='sharded' "
+                    "or 'single' (replica mode has one mesh per device)")
+            bad = [s for s in cfg.seq_buckets if s % cfg.sp]
+            if bad:
+                raise ValueError(
+                    f"ring attention shards the seq dim over sp={cfg.sp}; "
+                    f"seq buckets {bad} are not divisible")
         self.dtype = jnp.dtype(cfg.dtype)
         self.max_seq = max(cfg.seq_buckets)
         vocab_file = opt.get("vocab_file")
@@ -151,6 +184,11 @@ class BertServing(ServingModel):
             attention_impl=attention,
         )
         self.top_k = min(5, cfg.num_classes)
+
+    def bind_mesh(self, mesh: Any) -> None:
+        """Ring attention closes over the serving mesh's "seq" axis."""
+        if self.module.attention_impl == "ring":
+            self.module = self.module.clone(mesh=mesh)
 
     def import_tf_variables(self, flat: dict) -> Any:
         """HF transformers TFBert(ForSequenceClassification) -> this pytree.

@@ -82,7 +82,11 @@ class ModelRuntime:
         elif self.mode == "single":
             self.meshes = [make_mesh(MeshPlan(), devices=[jax.devices()[0]])]
         else:
-            self.meshes = [mesh if mesh is not None else make_mesh(MeshPlan(tp=self.cfg.tp))]
+            self.meshes = [mesh if mesh is not None
+                           else make_mesh(MeshPlan(tp=self.cfg.tp, sp=self.cfg.sp))]
+        # Mesh-aware models (e.g. BERT ring attention) rebuild their forward
+        # around the serving mesh; must precede param load and compilation.
+        model.bind_mesh(self.meshes[0])
 
         if self.mode == "sharded":
             # Sharded-batch executables need batch % data-axis == 0; normalize
