@@ -384,6 +384,85 @@ class EfficientDetServing(ImageClassifierServing):
             dtype=jnp.dtype(cfg.dtype),
         )
 
+    def import_tf_variables(self, flat):
+        """Keras-applications EfficientNetB0 -> the backbone subtree.
+
+        There is no canonical TF EfficientDet artifact in this environment,
+        but the detector's backbone IS EfficientNet-B0, so a classification
+        checkpoint transfers it exactly — the standard detection transfer-
+        learning setup. BiFPN and heads keep their seeded init (logged); a
+        full-detector orbax checkpoint restores everything.
+
+        Source scheme (``tf.keras.applications.EfficientNetB0``): stem
+        ``stem_conv``/``stem_bn``; block ``block{stage}{a,b,...}_{expand_conv,
+        expand_bn, dwconv, bn, se_reduce, se_expand, project_conv,
+        project_bn}`` (stage-1 blocks have no expand: ratio 1). Depthwise
+        kernels transpose (H, W, C, 1) -> (H, W, 1, C); SE convs keep biases;
+        the classifier-only ``top_conv``/``top_bn``/``predictions`` and the
+        input ``normalization`` stats have no detector counterpart and are
+        skipped.
+        """
+        o = self.cfg.options
+        if (float(o.get("backbone_width", 1.0)), float(o.get("backbone_depth", 1.0))) != (1.0, 1.0):
+            raise ValueError(
+                "EfficientNetB0 import requires backbone_width/depth == 1.0")
+        f = {k.split(":")[0]: np.asarray(v) for k, v in flat.items()}
+
+        def conv(name):
+            return {"kernel": f[f"{name}/kernel"]}
+
+        def bn(name):
+            return (
+                {"scale": f[f"{name}/gamma"], "bias": f[f"{name}/beta"]},
+                {"mean": f[f"{name}/moving_mean"],
+                 "var": f[f"{name}/moving_variance"]},
+            )
+
+        bp: dict = {"stem": conv("stem_conv")}
+        bs: dict = {}
+        bp["bn_stem"], bs["bn_stem"] = bn("stem_bn")
+        bi = 0
+        for stage, (e, _c, r, _s, _k) in enumerate(B0_BLOCKS, start=1):
+            for j in range(r):
+                pre = f"block{stage}{'abcdefghij'[j]}"
+                p: dict = {}
+                st: dict = {}
+                if e != 1:
+                    p["expand"] = conv(f"{pre}_expand_conv")
+                    p["bn_expand"], st["bn_expand"] = bn(f"{pre}_expand_bn")
+                dw = f[f"{pre}_dwconv/kernel"]  # (H, W, C, 1)
+                p["depthwise"] = {"kernel": dw.transpose(0, 1, 3, 2)}
+                p["bn_dw"], st["bn_dw"] = bn(f"{pre}_bn")
+                p["se_reduce"] = {"kernel": f[f"{pre}_se_reduce/kernel"],
+                                  "bias": f[f"{pre}_se_reduce/bias"]}
+                p["se_expand"] = {"kernel": f[f"{pre}_se_expand/kernel"],
+                                  "bias": f[f"{pre}_se_expand/bias"]}
+                p["project"] = conv(f"{pre}_project_conv")
+                p["bn_project"], st["bn_project"] = bn(f"{pre}_project_bn")
+                bp[f"block{bi}"] = p
+                bs[f"block{bi}"] = st
+                bi += 1
+
+        full = self.init_params(jax.random.PRNGKey(0))
+        want = full["params"]["backbone"]
+        if jax.tree_util.tree_structure(bp) != jax.tree_util.tree_structure(want):
+            raise ValueError("imported backbone tree does not match the module")
+        for got, exp in zip(jax.tree_util.tree_leaves(bp),
+                            jax.tree_util.tree_leaves(want)):
+            if got.shape != exp.shape:
+                raise ValueError(
+                    f"backbone shape mismatch: imported {got.shape} vs "
+                    f"module {exp.shape}")
+        full["params"]["backbone"] = bp
+        full["batch_stats"]["backbone"] = bs
+        import logging
+
+        logging.getLogger("tpuserve.models").info(
+            "%s: EfficientNetB0 backbone imported; BiFPN/heads keep seeded "
+            "init (serve a full-detector orbax checkpoint for end-to-end "
+            "weights)", self.name)
+        return full
+
     def forward(self, params: Any, batch: Any) -> dict:
         x = self.prepare_batch(batch)
         cls_logits, box_reg = self.module.apply(params, x)  # (B,A,C), (B,A,4)
