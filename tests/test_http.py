@@ -204,3 +204,71 @@ def test_client_batch_over_limit_400(client):
         assert "limit" in (await resp.json())["error"]
 
     run(go())
+
+
+def test_two_models_one_server():
+    """Two families behind one server: independent batchers/runtimes,
+    per-model routing and metrics."""
+    import json as _json
+
+    cfg = ServerConfig(
+        models=[
+            ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                        deadline_ms=5.0, dtype="float32", num_classes=10,
+                        parallelism="single", request_timeout_ms=10_000.0),
+            ModelConfig(name="bert", family="bert", batch_buckets=[1],
+                        seq_buckets=[8], deadline_ms=5.0, dtype="float32",
+                        num_classes=3, parallelism="single",
+                        request_timeout_ms=10_000.0,
+                        options=dict(layers=1, d_model=16, heads=2, d_ff=32,
+                                     vocab_size=512)),
+        ],
+        decode_threads=2,
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r1 = await client.post("/v1/models/toy:classify", data=toy_image(),
+                                   headers={"Content-Type": "application/x-npy"})
+            assert r1.status == 200
+            r2 = await client.post(
+                "/v1/models/bert:classify",
+                data=_json.dumps({"text": "two models"}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert r2.status == 200, await r2.text()
+            inv = await (await client.get("/v1/models")).json()
+            assert set(inv) == {"toy", "bert"}
+            metrics = await (await client.get("/metrics")).text()
+            assert 'items_total{model="toy"}' in metrics
+            assert 'items_total{model="bert"}' in metrics
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_admin_reload_endpoint(client):
+    """Hot weight reload over HTTP: 200 with timing + canary, 404 unknown."""
+    run, c = client
+
+    async def go():
+        resp = await c.post("/admin/models/toy:reload")
+        assert resp.status == 200, await resp.text()
+        body = await resp.json()
+        assert body["model"] == "toy" and body["reload_ms"] > 0
+        assert body["canary_ok"] is True
+        # still serving after the swap
+        ok = await c.post("/v1/models/toy:classify", data=toy_image(),
+                          headers={"Content-Type": "application/x-npy"})
+        assert ok.status == 200
+        missing = await c.post("/admin/models/nosuch:reload")
+        assert missing.status == 404
+
+    run(go())

@@ -89,3 +89,49 @@ def test_padding_lanes_do_not_affect_real_lanes(toy_runtime):
     out4 = rt.fetch(rt.run((4,), padded))
     np.testing.assert_allclose(out1["probs"][0], out4["probs"][0], rtol=1e-5)
     np.testing.assert_array_equal(out1["indices"][0], out4["indices"][0])
+
+
+def test_hot_reload_swaps_weights_without_recompile(tmp_path):
+    """Write ckpt A, serve, overwrite with ckpt B at the same path, reload:
+    outputs change, no recompilation (executable objects identical)."""
+    from tpuserve.savedmodel import save_orbax
+
+    ckpt = str(tmp_path / "ckpt")
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[2],
+                      dtype="float32", num_classes=10, parallelism="single",
+                      weights=ckpt)
+    model = build(cfg)
+    params_a = model.init_params(jax.random.key(1))
+    save_orbax(ckpt, params_a)
+    rt = build_runtime(model)
+    exe_before = rt.executables[(2,)][0].compiled
+
+    batch = np.full((2, 8, 8, 3), 50, dtype=np.uint8)
+    out_a = rt.fetch(rt.run((2,), batch))
+
+    params_b = jax.tree_util.tree_map(lambda x: x + 0.5, params_a)
+    import shutil
+
+    shutil.rmtree(ckpt)
+    save_orbax(ckpt, params_b)
+    info = rt.reload_params()
+    assert info["reload_ms"] > 0
+
+    out_b = rt.fetch(rt.run((2,), batch))
+    assert rt.executables[(2,)][0].compiled is exe_before  # no recompile
+    assert not np.allclose(out_a["probs"], out_b["probs"])
+
+
+def test_hot_reload_rejects_mismatched_tree(toy_runtime):
+    model, rt = toy_runtime
+    before = rt.params_per_mesh
+    orig = model.load_params
+    model.load_params = lambda: {"w1": np.zeros((4, 4), np.float32)}
+    try:
+        with pytest.raises(ValueError, match="old params kept"):
+            rt.reload_params()
+    finally:
+        model.load_params = orig
+    assert rt.params_per_mesh is before  # still serving the old weights
+    batch = np.full((2, 8, 8, 3), 9, dtype=np.uint8)
+    assert rt.fetch(rt.run((2,), batch))["probs"].shape == (2, 3)

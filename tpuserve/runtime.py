@@ -105,6 +105,7 @@ class ModelRuntime:
         self.executables: dict[tuple, list[Executable]] = {}
         self._rr = 0  # round-robin cursor for replica mode
         self._rr_lock = threading.Lock()
+        self._reload_lock = threading.Lock()
 
     # -- startup ------------------------------------------------------------
     def load_and_shard_params(self) -> None:
@@ -114,6 +115,9 @@ class ModelRuntime:
         # ops; (b) on the tunneled dev TPU, reading back accelerator-side
         # buffers flips the relay into a ~30 MB/s synchronous-transfer mode,
         # so param init must never touch the accelerator.
+        self.params_per_mesh = self._shard_onto_meshes(self._load_host_params())
+
+    def _load_host_params(self) -> Any:
         try:
             cpu = jax.local_devices(backend="cpu")[0]
         except RuntimeError:
@@ -125,17 +129,19 @@ class ModelRuntime:
             params = self.model.load_params()
         params = jax.device_get(params)
         dtype = jnp.dtype(self.cfg.dtype)
-        params = jax.tree_util.tree_map(
+        return jax.tree_util.tree_map(
             lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             params,
         )
+
+    def _shard_onto_meshes(self, params: Any) -> list:
         rules = self.model.partition_rules()
+        out = []
         for mesh in self.meshes:
             specs = match_partition_rules(rules, params)
             shardings = specs_to_shardings(specs, mesh)
-            self.params_per_mesh.append(
-                jax.tree_util.tree_map(jax.device_put, params, shardings)
-            )
+            out.append(jax.tree_util.tree_map(jax.device_put, params, shardings))
+        return out
 
     def compile_all(self, pool: cf.ThreadPoolExecutor | None = None) -> None:
         """AOT-compile every bucket (in parallel when a pool is given)."""
@@ -213,6 +219,39 @@ class ModelRuntime:
     def fetch(outputs: Any) -> Any:
         """Block for D2H; call off the event loop."""
         return jax.tree_util.tree_map(np.asarray, outputs)
+
+    # -- weight reload -------------------------------------------------------
+    def reload_params(self) -> dict:
+        """Hot-swap weights from cfg.weights without recompiling.
+
+        The executables were compiled against param avals (shape, dtype) and
+        shardings, so any matching reload (updated checkpoint at the same
+        path) slots straight in. The fresh tree is built and validated OFF
+        the serving path and published as one reference assignment — no
+        window where inference can observe a half-validated tree; in-flight
+        batches finish on the old params (their dispatch captured the
+        references). A mismatched tree raises and the old params keep
+        serving. Serialized: concurrent reloads would let a failing call
+        resurrect weights an earlier success replaced.
+        """
+        with self._reload_lock:
+            t0 = time.perf_counter()
+            fresh = self._shard_onto_meshes(self._load_host_params())
+            old = self.params_per_mesh
+            if old:
+                same_struct = (jax.tree_util.tree_structure(old[0])
+                               == jax.tree_util.tree_structure(fresh[0]))
+                if not same_struct or any(
+                    a.shape != b.shape or a.dtype != b.dtype
+                    for a, b in zip(jax.tree_util.tree_leaves(old[0]),
+                                    jax.tree_util.tree_leaves(fresh[0]))):
+                    raise ValueError(
+                        "reloaded weights do not match the compiled "
+                        "shapes/dtypes; old params kept")
+            self.params_per_mesh = fresh
+            return {"model": self.model.name,
+                    "reload_ms": round((time.perf_counter() - t0) * 1e3, 1),
+                    "params": self.describe()["params"]}
 
     # -- info ---------------------------------------------------------------
     def describe(self) -> dict:

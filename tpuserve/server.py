@@ -99,17 +99,22 @@ class ServerState:
         if self.cfg.startup_canary:
             await self.run_canaries()
 
+    async def run_canary(self, name: str) -> bool:
+        """Tiny end-to-end inference for one model; feeds /healthz."""
+        model = self.models[name]
+        try:
+            item = model.canary_item()
+            fut = self.batchers[name].submit(item, group=model.group_key(item))
+            await asyncio.wait_for(fut, timeout=60.0)
+            self.canary_ok[name] = True
+        except Exception:
+            log.exception("canary failed for %s", name)
+            self.canary_ok[name] = False
+        return self.canary_ok[name]
+
     async def run_canaries(self) -> None:
-        """Tiny end-to-end inference per model; feeds /healthz."""
-        for name, model in self.models.items():
-            try:
-                item = model.canary_item()
-                fut = self.batchers[name].submit(item, group=model.group_key(item))
-                await asyncio.wait_for(fut, timeout=60.0)
-                self.canary_ok[name] = True
-            except Exception:
-                log.exception("canary failed for %s", name)
-                self.canary_ok[name] = False
+        for name in self.models:
+            await self.run_canary(name)
 
     async def stop(self) -> None:
         # Deferred pools first retire their active workers (fast) so batcher
@@ -248,6 +253,32 @@ See <a href="/v1/models">models</a>, <a href="/metrics">metrics</a>,
 """
 
 
+async def handle_reload(request: web.Request) -> web.Response:
+    """POST /admin/models/{name}:reload — hot-swap weights from disk.
+
+    Same shapes slot into the compiled executables with zero recompilation;
+    a mismatched checkpoint 409s and the old weights keep serving. The
+    canary reruns so /healthz reflects the new weights."""
+    state: ServerState = request.app[STATE_KEY]
+    name = request.match_info["name"]
+    rt = state.runtimes.get(name)
+    if rt is None:
+        return _err(404, f"unknown model {name!r}")
+    if not hasattr(rt, "reload_params"):
+        return _err(409, "weight reload is not supported in recycle mode")
+    loop = asyncio.get_running_loop()
+    try:
+        # Default executor, NOT state.pool: a slow checkpoint load must not
+        # occupy a decode/fetch thread the batcher depends on.
+        info = await loop.run_in_executor(None, rt.reload_params)
+    except ValueError as e:
+        return _err(409, str(e))
+    except Exception as e:  # noqa: BLE001
+        return _err(500, f"reload failed: {e}")
+    info["canary_ok"] = await state.run_canary(name)
+    return web.json_response(info)
+
+
 async def handle_index(request: web.Request) -> web.Response:
     return web.Response(text=_INDEX_HTML, content_type="text/html")
 
@@ -264,6 +295,7 @@ def make_app(state: ServerState) -> web.Application:
     for verb in _VERBS:
         app.router.add_post(f"/v1/models/{{name}}:{verb}", handle_predict)
     app.router.add_get("/v1/models", handle_models)
+    app.router.add_post("/admin/models/{name}:reload", handle_reload)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/stats", handle_stats)
