@@ -80,11 +80,12 @@ def test_padded_lanes_do_not_affect_real_lanes(sd_model):
 
 def test_tokenize_fixed_77(sd_model):
     m, _, _ = sd_model
-    ids, seed = m.host_decode(b'{"prompt": "a b c", "seed": 5}', "application/json")
+    ids, neg, seed = m.host_decode(b'{"prompt": "a b c", "seed": 5}', "application/json")
     assert ids.shape == (MAX_TOKENS,) and ids.dtype == np.int32
+    assert neg.shape == (MAX_TOKENS,)  # empty negative, still fixed-shape
     assert int(seed) == 5
     long = b'{"prompt": "' + b"word " * 200 + b'"}'
-    ids2, _ = m.host_decode(long, "application/json")
+    ids2, _, _ = m.host_decode(long, "application/json")
     assert ids2.shape == (MAX_TOKENS,)
     with pytest.raises(ValueError):
         m.host_decode(b'{"seed": 1}', "application/json")
@@ -154,3 +155,25 @@ def test_http_generate_end_to_end():
         assert bad_status == 400
     finally:
         loop.close()
+
+
+def test_negative_prompt_steers_and_defaults_to_empty(sd_model):
+    """negative_prompt rides the CFG uncond lane: setting one changes the
+    image; leaving it unset equals an explicit empty negative."""
+    m, params, fwd = sd_model
+    base = m.host_decode(b'{"prompt": "a cat", "seed": 4}', "application/json")
+    explicit_empty = m.host_decode(
+        b'{"prompt": "a cat", "negative_prompt": "", "seed": 4}',
+        "application/json")
+    steered = m.host_decode(
+        b'{"prompt": "a cat", "negative_prompt": "a dog", "seed": 4}',
+        "application/json")
+    o_base = np.asarray(fwd(params, m.assemble([base], (1,)))["image"])
+    o_empty = np.asarray(fwd(params, m.assemble([explicit_empty], (1,)))["image"])
+    o_steer = np.asarray(fwd(params, m.assemble([steered], (1,)))["image"])
+    np.testing.assert_array_equal(o_base, o_empty)
+    assert not np.array_equal(o_base, o_steer)
+
+    with pytest.raises(ValueError, match="negative_prompt"):
+        m.host_decode(b'{"prompt": "x", "negative_prompt": 5}',
+                      "application/json")

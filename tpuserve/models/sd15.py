@@ -313,7 +313,9 @@ def ddim_schedule(steps: int, train_steps: int = 1000,
 # -- serving -------------------------------------------------------------------
 
 class SD15Serving(ServingModel):
-    """txt2img over HTTP: JSON {"prompt", "seed"?, } in, PNG bytes out."""
+    """txt2img over HTTP: JSON {"prompt", "negative_prompt"?, "seed"?} in,
+    PNG bytes out. The negative prompt rides the classifier-free-guidance
+    uncond lane (empty prompt when unset), steering generation away from it."""
 
     def __init__(self, cfg: ModelConfig) -> None:
         super().__init__(cfg)
@@ -351,8 +353,6 @@ class SD15Serving(ServingModel):
             mults=tuple(o.get("vae_mults", (1, 2, 4, 4))),
             dtype=self.dtype)
         self.schedule = ddim_schedule(self.steps)
-        # Fixed unconditional (empty prompt) ids, baked into the executable.
-        self.uncond_ids = self._tokenize("")
 
     # -- params ---------------------------------------------------------------
     def init_params(self, rng: jax.Array) -> Any:
@@ -372,19 +372,19 @@ class SD15Serving(ServingModel):
         (b,) = bucket
         return (
             jax.ShapeDtypeStruct((b, MAX_TOKENS), jnp.int32),
+            jax.ShapeDtypeStruct((b, MAX_TOKENS), jnp.int32),
             jax.ShapeDtypeStruct((b,), jnp.int32),
         )
 
     # -- device side ----------------------------------------------------------
     def forward(self, params: Any, batch: Any) -> dict:
-        ids, seeds = batch
+        ids, neg_ids, seeds = batch
         b = ids.shape[0]
-        cond = self.text_encoder.apply(params["text"], ids)
-        # Encode the (constant) empty prompt once and broadcast: the text
-        # tower would otherwise do B-fold redundant work per batch.
-        uncond = self.text_encoder.apply(params["text"], self.uncond_ids[None, :])
-        ctx2 = jnp.concatenate(
-            [jnp.broadcast_to(uncond, cond.shape), cond], axis=0)  # (2B, 77, D)
+        # One 2B text-encoder call covers cond + per-item uncond: negative
+        # prompts make the uncond row per-request (empty prompt when unset),
+        # and the text tower is a rounding error next to `steps` UNet calls.
+        ctx2 = self.text_encoder.apply(
+            params["text"], jnp.concatenate([neg_ids, ids], axis=0))  # (2B, 77, D)
 
         keys = jax.vmap(lambda s: jax.random.fold_in(jax.random.key(0), s))(seeds)
         lat = jax.vmap(lambda k: jax.random.normal(
@@ -420,10 +420,15 @@ class SD15Serving(ServingModel):
             prompt = body.get("prompt")
             if not isinstance(prompt, str):
                 raise ValueError('JSON body must contain "prompt": str')
+            negative = body.get("negative_prompt", "")
+            if not isinstance(negative, str):
+                raise ValueError('"negative_prompt" must be a string')
             seed = int(body.get("seed", 0))
         else:
-            prompt, seed = payload.decode("utf-8"), 0
-        return self._tokenize(prompt), np.int32(seed)
+            prompt, negative, seed = payload.decode("utf-8"), "", 0
+        # The negative prompt rides the classifier-free-guidance uncond lane
+        # (empty prompt when unset), steering generation AWAY from it.
+        return self._tokenize(prompt), self._tokenize(negative), np.int32(seed)
 
     def canary_item(self) -> Any:
         return self.host_decode(b'{"prompt": "canary", "seed": 1}',
