@@ -94,6 +94,78 @@ def test_distributed_config_from_toml(tmp_path):
     assert load_config(None).distributed.coordinator_address == ""
 
 
+def _cpu_subprocess_env(fake_devices: int | None = None) -> dict:
+    """Env for child JAX processes that must stay on fake CPU devices.
+
+    The dev box's sitecustomize re-registers the tunneled-TPU platform
+    (overriding JAX_PLATFORMS) whenever PALLAS_AXON_POOL_IPS is set, so it
+    must be absent from the child env."""
+    repo_root = str(Path(__file__).resolve().parents[1])
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if fake_devices:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={fake_devices}"
+    return env
+
+
+def test_two_process_collectives_across_the_dcn_seam():
+    """The real thing, minus the hardware: two OS processes (4 fake CPU
+    devices each) form one 8-device jax.distributed cluster through
+    init_distributed, build the host-major (data, model, seq) mesh, and a
+    jitted global reduction crosses the process boundary — the exact
+    topology a 2-host TPU pod serves with, DCN seam included."""
+    port = 17000 + os.getpid() % 2000
+    code = (
+        "import sys\n"
+        "rank = int(sys.argv[1])\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "from tpuserve.config import DistributedConfig\n"
+        "from tpuserve.parallel import init_distributed, make_mesh, process_info\n"
+        "from tpuserve.parallel.mesh import MeshPlan\n"
+        f"cfg = DistributedConfig(coordinator_address='127.0.0.1:{port}',"
+        " num_processes=2, process_id=rank)\n"
+        "assert init_distributed(cfg) is True\n"
+        "info = process_info()\n"
+        "assert (info['process_count'], info['global_devices']) == (2, 8), info\n"
+        "mesh = make_mesh(MeshPlan(tp=2))\n"
+        "for block in mesh.devices.reshape(-1, 2):\n"
+        "    hosts = {d.process_index for d in block}\n"
+        "    assert len(hosts) == 1, f'tp block crosses hosts: {hosts}'\n"
+        "sh = NamedSharding(mesh, P('data'))\n"
+        "y = jax.jit(lambda: jnp.arange(8.0), out_shardings=sh)()\n"
+        "total = jax.jit(jnp.sum)(y)  # cross-process (DCN-seam) reduction\n"
+        "print(f'RANK{rank} OK total={float(total)} "
+        "hosts={len(set(d.process_index for d in jax.devices()))}')\n"
+    )
+    env = _cpu_subprocess_env(fake_devices=4)
+    # File-backed output: draining two interdependent children through pipes
+    # sequentially can deadlock on a full pipe buffer mid-handshake.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        logs = [open(f"{td}/rank{r}.log", "w+") for r in range(2)]
+        procs = [subprocess.Popen([sys.executable, "-c", code, str(r)],
+                                  stdout=logs[r], stderr=subprocess.STDOUT,
+                                  text=True, env=env) for r in range(2)]
+        try:
+            for p in procs:
+                p.wait(timeout=180)
+        finally:
+            for p in procs:  # reap stragglers so no orphan holds the port
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            outs = []
+            for lg in logs:
+                lg.seek(0)
+                outs.append(lg.read())
+                lg.close()
+        for r, out in enumerate(outs):
+            assert f"RANK{r} OK total=28.0 hosts=2" in out, (r, out[-2000:])
+
+
 def test_real_initialize_single_process_subprocess():
     """jax.distributed.initialize actually handshakes (1-process cluster).
 
@@ -113,11 +185,8 @@ def test_real_initialize_single_process_subprocess():
         "assert info['global_devices'] >= 1, info\n"
         "print('DIST_OK')\n"
     )
-    repo_root = str(Path(__file__).resolve().parents[1])
-    env = {**os.environ, "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")}
     out = subprocess.run(
         [sys.executable, "-c", code],
-        capture_output=True, text=True, timeout=120, env=env,
+        capture_output=True, text=True, timeout=120, env=_cpu_subprocess_env(),
     )
     assert "DIST_OK" in out.stdout, out.stderr[-2000:]
