@@ -79,3 +79,27 @@ def test_model_lookup_missing():
     cfg = ServerConfig()
     with pytest.raises(KeyError):
         cfg.model("nope")
+
+
+def test_import_model_cli_parses_opts(monkeypatch):
+    """--opt key=value reaches convert_cli as TOML-typed model options."""
+    from tpuserve import cli, savedmodel
+
+    captured = {}
+    monkeypatch.setattr(
+        savedmodel, "convert_cli",
+        lambda sm, fam, out, options=None: captured.update(
+            {"sm": sm, "fam": fam, "out": out, **(options or {})}))
+    rc = cli.main(["import-model", "--saved-model", "x", "--family", "bert",
+                   "--out", "y", "--opt", "layers=2",
+                   "--opt", "vocab_file=v.txt"])
+    assert rc == 0
+    assert captured == {"sm": "x", "fam": "bert", "out": "y",
+                        "layers": 2, "vocab_file": "v.txt"}
+
+
+def test_import_model_cli_rejects_reserved_opts():
+    from tpuserve import savedmodel
+
+    with pytest.raises(ValueError, match="weights"):
+        savedmodel.convert_cli("sm", "toy", "out", {"weights": "/elsewhere"})

@@ -49,6 +49,9 @@ def main(argv: list[str] | None = None) -> int:
     p_imp.add_argument("--saved-model", required=True)
     p_imp.add_argument("--family", required=True)
     p_imp.add_argument("--out", required=True)
+    p_imp.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE",
+                       help="model option for the import (TOML-parsed value), "
+                            "e.g. --opt vocab_file=vocab.txt --opt layers=24")
 
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
@@ -79,8 +82,15 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "import-model":
         from tpuserve import savedmodel
+        from tpuserve.config import _parse_toml_value
 
-        savedmodel.convert_cli(args.saved_model, args.family, args.out)
+        options = {}
+        for item in args.opt:
+            if "=" not in item:
+                parser.error(f"--opt must look like key=value, got {item!r}")
+            key, _, text = item.partition("=")
+            options[key.strip()] = _parse_toml_value(text.strip())
+        savedmodel.convert_cli(args.saved_model, args.family, args.out, options)
         return 0
 
     if args.cmd == "warmup":

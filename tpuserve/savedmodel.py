@@ -153,12 +153,29 @@ def extract_graphdef_constants(path: str) -> dict[str, np.ndarray]:
 
 # -- CLI ---------------------------------------------------------------------
 
-def convert_cli(saved_model_path: str, family: str, out_path: str) -> None:
-    """SavedModel/GraphDef -> orbax, so serving startup never needs TF."""
+def convert_cli(saved_model_path: str, family: str, out_path: str,
+                options: dict | None = None) -> None:
+    """SavedModel/GraphDef -> orbax, so serving startup never needs TF.
+
+    ``options`` configures the family for the import — keys naming
+    ModelConfig fields (e.g. num_classes, dtype, seq_buckets) set those
+    fields; everything else lands in ModelConfig.options (e.g. BERT's
+    vocab_file / layer sizes). The import must match the artifact."""
+    import dataclasses
+
     from tpuserve.config import ModelConfig
     from tpuserve import models as modelzoo
 
-    cfg = ModelConfig(name=family, family=family, weights=saved_model_path)
+    opts = dict(options or {})
+    reserved = {"name", "family", "weights", "options"}
+    bad = reserved & set(opts)
+    if bad:
+        raise ValueError(f"--opt cannot set {sorted(bad)}; use the dedicated "
+                         "CLI flags instead")
+    settable = {f.name for f in dataclasses.fields(ModelConfig)} - reserved
+    fields = {k: opts.pop(k) for k in list(opts) if k in settable}
+    cfg = ModelConfig(name=family, family=family, weights=saved_model_path,
+                      options=opts, **fields)
     model = modelzoo.build(cfg)
     params = load_params_for(model)
     save_orbax(out_path, params)
