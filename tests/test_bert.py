@@ -155,18 +155,19 @@ def test_bad_json_raises(served):
 
 # -- sequence-parallel serving -----------------------------------------------
 
-def test_ring_attention_serving_matches_dense():
-    """attention="ring" + sp=2 on the sharded 8-device mesh: seq-sharded
-    activations, K/V around the ICI ring, identical logits (incl. padding)."""
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sequence_parallel_serving_matches_dense(impl):
+    """attention=ring|ulysses + sp=2 on the sharded 8-device mesh:
+    seq-sharded activations (K/V ppermute rotation vs head all-to-all),
+    identical logits incl. a padded lane; the AOT-compiled path runs."""
     import jax
 
     from tpuserve.runtime import build_runtime
 
-    cfg_ring = tiny_cfg(parallelism="sharded", sp=2, batch_buckets=[4],
-                        seq_buckets=[16],
-                        options={**TINY, "attention": "ring"})
-    ring = build(cfg_ring)
-    rt = build_runtime(ring)  # binds the mesh + AOT-compiles the SP forward
+    sp_model = build(tiny_cfg(parallelism="sharded", sp=2, batch_buckets=[4],
+                              seq_buckets=[16],
+                              options={**TINY, "attention": impl}))
+    rt = build_runtime(sp_model)  # binds the mesh + AOT-compiles SP forward
     dense = build(tiny_cfg(batch_buckets=[4], seq_buckets=[16]))
 
     items = [dense.host_decode(
@@ -174,14 +175,19 @@ def test_ring_attention_serving_matches_dense():
         "application/json") for i in range(3)]  # 3 of 4 lanes real
     batch = dense.assemble(items, (4, 16))
     params = dense.init_params(jax.random.key(0))  # same tree either impl
-    out_ring = rt.run((4, 16), batch)
-    out_dense = jax.jit(dense.forward)(params, batch)
-    # Same params: the runtime loaded its own; rerun ring's forward with
+    # Same params: the runtime loaded its own; rerun the SP forward with
     # dense's params for the apples-to-apples check.
-    out_ring2 = jax.jit(ring.forward)(params, batch)
-    np.testing.assert_allclose(np.asarray(out_ring2["probs"]),
+    out_sp = jax.jit(sp_model.forward)(params, batch)
+    out_dense = jax.jit(dense.forward)(params, batch)
+    np.testing.assert_allclose(np.asarray(out_sp["probs"]),
                                np.asarray(out_dense["probs"]), atol=1e-5)
-    assert np.asarray(out_ring["probs"]).shape == (4, 4)  # compiled path runs
+    assert np.asarray(rt.run((4, 16), batch)["probs"]).shape == (4, 4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    with pytest.raises(ValueError, match="heads"):
+        build(tiny_cfg(parallelism="sharded", sp=4, seq_buckets=[16],
+                       options={**TINY, "attention": "ulysses", "heads": 2}))
 
 
 def test_ring_requires_divisible_seq_buckets():

@@ -42,11 +42,11 @@ class BertBlock(nn.Module):
     heads: int
     d_ff: int
     dtype: Any = jnp.bfloat16
-    # "dense" (XLA einsum) | "flash" (Pallas fused kernel) | "ring"
-    # (sequence-parallel over the serving mesh's "seq" axis).
+    # "dense" (XLA einsum) | "flash" (Pallas fused kernel) | "ring" /
+    # "ulysses" (sequence-parallel over the serving mesh's "seq" axis).
     attention_impl: str = "dense"
     ln_eps: float = 1e-12  # original BERT value; keeps imported weights exact
-    mesh: Any = None  # required for "ring"
+    mesh: Any = None  # required for "ring" / "ulysses"
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -59,21 +59,25 @@ class BertBlock(nn.Module):
             # mask_bias is (B, 1, 1, S) additive; flash takes per-key (B, S).
             fn = lambda q, k, v, **kw: flash_attention(  # noqa: E731
                 q, k, v, mask_bias[:, 0, 0, :])
-        elif self.attention_impl == "ring":
+        elif self.attention_impl in ("ring", "ulysses"):
             from jax.sharding import PartitionSpec as P
 
-            from tpuserve.ops import ring_attention
+            from tpuserve.ops import ring_attention, ulysses_attention
 
             if self.mesh is None:
                 raise ValueError(
-                    "attention='ring' needs the serving mesh: the runtime "
-                    "calls bind_mesh(mesh); do the same before forward")
+                    f"attention={self.attention_impl!r} needs the serving "
+                    "mesh: the runtime calls bind_mesh(mesh); do the same "
+                    "before forward")
             # Activations reshard (batch on "data", seq on "seq") at the
-            # shard_map boundary; K/V then rotate around the ICI ring. Heads
-            # stay tensor-parallel through the ring when tp divides them.
+            # shard_map boundary; the op then moves K/V (ring: ppermute
+            # rotation) or heads (ulysses: all-to-all) over ICI. Heads stay
+            # tensor-parallel when tp divides them.
+            sp_attn = (ring_attention if self.attention_impl == "ring"
+                       else ulysses_attention)
             head_axis = ("model"
                          if self.heads % self.mesh.shape["model"] == 0 else None)
-            fn = lambda q, k, v, **kw: ring_attention(  # noqa: E731
+            fn = lambda q, k, v, **kw: sp_attn(  # noqa: E731
                 q, k, v, self.mesh, key_padding=mask_bias[:, 0, 0, :],
                 spec=P("data", "seq", head_axis, None))
         else:
@@ -138,9 +142,9 @@ class BertServing(ServingModel):
         super().__init__(cfg)
         opt = cfg.options
         attention = str(opt.get("attention", "dense"))
-        if attention not in ("dense", "flash", "ring"):
-            raise ValueError("options.attention must be 'dense', 'flash', or "
-                             f"'ring', got {attention!r}")
+        if attention not in ("dense", "flash", "ring", "ulysses"):
+            raise ValueError("options.attention must be 'dense', 'flash', "
+                             f"'ring', or 'ulysses', got {attention!r}")
         if (attention == "flash" and cfg.parallelism == "sharded"
                 and jax.default_backend() == "tpu" and len(jax.devices()) > 1):
             # Mosaic kernels can't be auto-partitioned by a multi-device jit
@@ -150,18 +154,30 @@ class BertServing(ServingModel):
                 "options.attention='flash' requires parallelism='replica' or "
                 "'single' on a multi-chip mesh (Pallas kernels are not "
                 "auto-partitioned under a sharded jit)")
-        if attention == "ring":
+        if attention in ("ring", "ulysses"):
             if cfg.parallelism == "replica":
                 # One shared module can't close over N per-replica meshes;
-                # a ring over a 1-device replica is pointless anyway.
+                # SP over a 1-device replica is pointless anyway.
                 raise ValueError(
-                    "options.attention='ring' requires parallelism='sharded' "
-                    "or 'single' (replica mode has one mesh per device)")
+                    f"options.attention={attention!r} requires parallelism="
+                    "'sharded' or 'single' (replica mode has one mesh per "
+                    "device)")
             bad = [s for s in cfg.seq_buckets if s % cfg.sp]
             if bad:
                 raise ValueError(
-                    f"ring attention shards the seq dim over sp={cfg.sp}; "
-                    f"seq buckets {bad} are not divisible")
+                    f"{attention} attention shards the seq dim over "
+                    f"sp={cfg.sp}; seq buckets {bad} are not divisible")
+        if attention == "ulysses":
+            # The all-to-all deals LOCAL heads (after any tp split) across
+            # the seq axis; mirror the op's check at build time so a bad
+            # config fails with guidance, not at AOT compile.
+            heads = int(opt.get("heads", 12))
+            local = heads // cfg.tp if heads % cfg.tp == 0 else heads
+            if local % cfg.sp:
+                raise ValueError(
+                    f"ulysses attention deals heads over sp={cfg.sp}; "
+                    f"local heads {local} (heads={heads}, tp={cfg.tp}) "
+                    "are not divisible")
         self.dtype = jnp.dtype(cfg.dtype)
         self.max_seq = max(cfg.seq_buckets)
         vocab_file = opt.get("vocab_file")
@@ -179,15 +195,16 @@ class BertServing(ServingModel):
             max_seq=self.max_seq,
             num_classes=cfg.num_classes,
             dtype=self.dtype,
-            # "flash" routes attention through the Pallas fused kernel
-            # (tpuserve.ops.flash_attention); "dense" is the XLA einsum path.
+            # "dense" = XLA einsum; "flash" = Pallas fused kernel
+            # (tpuserve.ops.flash_attention); "ring"/"ulysses" =
+            # sequence-parallel over the serving mesh (tpuserve.ops).
             attention_impl=attention,
         )
         self.top_k = min(5, cfg.num_classes)
 
     def bind_mesh(self, mesh: Any) -> None:
-        """Ring attention closes over the serving mesh's "seq" axis."""
-        if self.module.attention_impl == "ring":
+        """Sequence-parallel attention closes over the serving mesh."""
+        if self.module.attention_impl in ("ring", "ulysses"):
             self.module = self.module.clone(mesh=mesh)
 
     def import_tf_variables(self, flat: dict) -> Any:
