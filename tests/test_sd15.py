@@ -177,3 +177,38 @@ def test_negative_prompt_steers_and_defaults_to_empty(sd_model):
     with pytest.raises(ValueError, match="negative_prompt"):
         m.host_decode(b'{"prompt": "x", "negative_prompt": 5}',
                       "application/json")
+
+
+def _write_tiny_bpe(tmp_path):
+    import json as _json
+
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1, "a</w>": 2,
+             "cat</w>": 3, "c": 4, "at</w>": 5, "a": 6, "t</w>": 7}
+    (tmp_path / "vocab.json").write_text(_json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text("#version: 0.2\na t</w>\nc at</w>\n")
+    return str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt")
+
+
+def test_clip_bpe_tokenizer_contract(tmp_path):
+    """CLIP-style byte-level BPE behind the WordPiece encode() contract:
+    BOS + merged pieces + EOS, EOS-padded fixed length."""
+    from tpuserve.text import CLIPBPETokenizer
+
+    vocab_file, merges_file = _write_tiny_bpe(tmp_path)
+    tok = CLIPBPETokenizer(vocab_file, merges_file)
+    ids, mask = tok.encode("a cat", 8)
+    assert ids.shape == (8,) and mask.shape == (8,)
+    assert list(ids[:4]) == [0, 2, 3, 1]  # BOS, a</w>, merged cat</w>, EOS
+    assert list(mask) == [1, 1, 1, 1, 0, 0, 0, 0]
+    assert ids[4:].tolist() == [tok.pad_id] * 4  # EOS-padded
+
+
+def test_sd15_serves_with_bpe_tokenizer(tmp_path):
+    """options.bpe_vocab/bpe_merges swap the prompt tokenizer by config."""
+    vocab_file, merges_file = _write_tiny_bpe(tmp_path)
+    m = build(sd_cfg(options={**TINY, "bpe_vocab": vocab_file,
+                              "bpe_merges": merges_file}))
+    ids, neg, seed = m.host_decode(b'{"prompt": "a cat", "seed": 2}',
+                                   "application/json")
+    assert ids.shape == (MAX_TOKENS,) and list(ids[:4]) == [0, 2, 3, 1]
+    assert m.text_encoder.vocab_size == 8  # sized from the BPE vocab

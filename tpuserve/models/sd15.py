@@ -28,7 +28,9 @@ latent scale 0.18215). Tokenization reuses the WordPiece machinery from
 ``tpuserve.text`` with BOS/EOS framing and fixed length 77 — no pretrained
 BPE artifacts exist in this container (SURVEY.md §0.1), and with seeded
 random weights the tokenizer only needs to be deterministic, not CLIP-BPE
-compatible; ``options["vocab_file"]`` swaps in a real vocabulary.
+compatible. Real artifacts: ``options["bpe_vocab"]``/``["bpe_merges"]``
+load CLIP's byte-level BPE (tpuserve.text.CLIPBPETokenizer);
+``options["vocab_file"]`` swaps in a WordPiece vocabulary.
 """
 
 from __future__ import annotations
@@ -46,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from tpuserve.config import ModelConfig
 from tpuserve.models.base import ServingModel
-from tpuserve.text import WordPieceTokenizer, synthetic_vocab
+from tpuserve.text import CLIPBPETokenizer, WordPieceTokenizer, synthetic_vocab
 
 MAX_TOKENS = 77  # CLIP text context length; SD conditions on all 77 states.
 
@@ -329,7 +331,14 @@ class SD15Serving(ServingModel):
         vae_mults = tuple(o.get("vae_mults", (1, 2, 4, 4)))
         self.latent = cfg.image_size // (2 ** (len(vae_mults) - 1))
         vocab_file = o.get("vocab_file")
-        if vocab_file:
+        if bool(o.get("bpe_vocab")) != bool(o.get("bpe_merges")):
+            raise ValueError(
+                "bpe_vocab and bpe_merges must be set together "
+                "(CLIP BPE needs vocab.json + merges.txt)")
+        if o.get("bpe_vocab"):
+            # Real SD/CLIP artifacts: byte-level BPE (vocab.json + merges.txt).
+            self.tokenizer = CLIPBPETokenizer(o["bpe_vocab"], o["bpe_merges"])
+        elif vocab_file:
             self.tokenizer = WordPieceTokenizer.from_vocab_file(vocab_file)
         else:
             self.tokenizer = WordPieceTokenizer(

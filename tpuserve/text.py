@@ -187,3 +187,35 @@ def synthetic_vocab(size: int = 8192, seed: int = 0) -> dict[str, int]:
             seen.add(t)
             toks.append(t)
     return {t: i for i, t in enumerate(toks[:size])}
+
+
+class CLIPBPETokenizer:
+    """Byte-pair tokenizer for CLIP-family artifacts (SD 1.5 prompts).
+
+    Real Stable Diffusion checkpoints pair the text encoder with OpenAI
+    CLIP's byte-level BPE (vocab.json + merges.txt), not WordPiece. This
+    wraps ``transformers.CLIPTokenizer`` (baked into the image; slow
+    pure-python path, amortized by the decode threadpool) behind the same
+    ``encode(text, max_len) -> (ids, mask)`` contract WordPiece exposes, so
+    ``tpuserve.models.sd15`` swaps tokenizers by config alone.
+    """
+
+    def __init__(self, vocab_file: str, merges_file: str) -> None:
+        from transformers import CLIPTokenizer
+
+        self.tok = CLIPTokenizer(vocab_file=vocab_file, merges_file=merges_file)
+        self.vocab: dict[str, int] = dict(self.tok.get_vocab())
+        self.pad_id = int(self.tok.eos_token_id)  # CLIP pads with EOS
+        self.bos_id = int(self.tok.bos_token_id)
+        self.eos_id = int(self.tok.eos_token_id)
+
+    def encode(self, text: str, max_len: int) -> tuple[np.ndarray, np.ndarray]:
+        """Text -> (BOS ids EOS + EOS-padding, mask), fixed max_len."""
+        out = self.tok(text, padding="max_length", truncation=True,
+                       max_length=max_len)
+        ids = np.asarray(out["input_ids"], np.int32)
+        mask = np.asarray(out["attention_mask"], np.int32)
+        return ids, mask
+
+    def n_tokens(self, text: str) -> int:
+        return len(self.tok(text)["input_ids"])
