@@ -521,6 +521,8 @@ class DeferredPool:
             "family": self.mcfg.family,
             "mode": "recycle",
             "dtype": self.mcfg.dtype,
+            "weights": self.mcfg.weights,
+            "options": dict(self.mcfg.options),
             "workers_alive": len([w for w in self._workers if w.proc.is_alive()]),
             "warm": len(self._warm),
             "epoch_images": self.cap_rows,

@@ -262,6 +262,11 @@ class ModelRuntime:
             "family": self.cfg.family,
             "mode": self.mode,
             "dtype": self.cfg.dtype,
+            # Provenance + behavior knobs operators need to see live: seeded
+            # random weights (None) vs a real artifact, and per-family options
+            # like BERT's attention impl.
+            "weights": self.cfg.weights,
+            "options": dict(self.cfg.options),
             "replicas": len(self.meshes),
             "mesh_shape": dict(self.meshes[0].shape),
             "buckets": [list(b) for b in sorted(self.executables)],
