@@ -81,3 +81,30 @@ def test_rgb_to_yuv420_roundtrip_gray():
     rgb = np.full((32, 32, 3), 128, np.uint8)
     y, u, v = preproc.rgb_to_yuv420(rgb)
     assert np.all(y == 128) and np.all(u == 128) and np.all(v == 128)
+
+
+def test_decode_npy_items_single_vs_batch():
+    """One parse decides single vs client batch; over-limit rejects."""
+    import io
+
+    from tpuserve import preproc
+
+    def npy(arr):
+        buf = io.BytesIO()
+        np.save(buf, arr)
+        return buf.getvalue()
+
+    one = np.random.default_rng(0).integers(0, 255, (16, 16, 3), dtype=np.uint8)
+    items, batched = preproc.decode_npy_items(npy(one), 16, max_items=8)
+    assert not batched and len(items) == 1
+    np.testing.assert_array_equal(items[0], one)
+
+    batch = np.stack([one, one + 1])
+    items, batched = preproc.decode_npy_items(npy(batch), 16, max_items=8)
+    assert batched and len(items) == 2
+    # resize path: wire edge differs
+    items, _ = preproc.decode_npy_items(npy(batch), 8, max_items=8)
+    assert items[0].shape == (8, 8, 3)
+
+    with pytest.raises(ValueError, match="limit"):
+        preproc.decode_npy_items(npy(np.zeros((9, 4, 4, 3), np.uint8)), 4, max_items=8)
