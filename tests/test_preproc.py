@@ -85,9 +85,6 @@ def test_rgb_to_yuv420_roundtrip_gray():
 
 def test_decode_npy_items_single_vs_batch():
     """One parse decides single vs client batch; over-limit rejects."""
-    import io
-
-    from tpuserve import preproc
 
     def npy(arr):
         buf = io.BytesIO()
