@@ -11,6 +11,8 @@ Submodules:
 - ``mesh``        — mesh construction (dp/tp/sp axes, host-major multi-host grid)
 - ``partition``   — regex partition rules -> PartitionSpec pytrees
 - ``distributed`` — jax.distributed.initialize seam for multi-host pods
+- ``pipeline``    — GPipe-style pipeline parallelism over a "stage" axis
+                    (stage-sharded stacked params, ppermute microbatch flow)
 
 Sequence parallelism for long contexts lives at the op level:
 ``tpuserve.ops.ring_attention`` (shard_map + ppermute over the "seq" axis)
@@ -18,6 +20,11 @@ and ``tpuserve.ops.ulysses`` (head all-to-all).
 """
 
 from tpuserve.parallel.distributed import init_distributed, process_info  # noqa: F401
+from tpuserve.parallel.pipeline import (  # noqa: F401
+    make_stage_mesh,
+    pipeline_forward,
+    stack_stage_params,
+)
 from tpuserve.parallel.mesh import (  # noqa: F401
     MeshPlan,
     host_major_grid,
