@@ -13,8 +13,12 @@
 - ``flash_attention`` — the single-device realization of the same recurrence
   as a fused Pallas TPU kernel: K/V stream through VMEM in blocks, the score
   matrix never touches HBM. Used by BERT via ``options.attention = "flash"``.
+- ``moe`` — Switch-style mixture-of-experts FFN: static top-1 routing with
+  fixed capacity (all einsums, no dynamic shapes), expert dim sharded on
+  "model" for expert parallelism (XLA inserts the token all-to-alls).
 """
 
 from tpuserve.ops.flash_attention import flash_attention  # noqa: F401
+from tpuserve.ops.moe import SwitchFFN, switch_route  # noqa: F401
 from tpuserve.ops.ring_attention import dense_attention, ring_attention  # noqa: F401
 from tpuserve.ops.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,117 @@
+"""Switch-style mixture-of-experts FFN with expert parallelism (EP).
+
+Expert parallelism is the last axis in SURVEY.md §2.1's strategy table;
+none of the judged configs is an MoE, so it was scoped out of v1 — this
+module makes the seam real. Design is TPU-first throughout:
+
+- **Everything static.** Top-1 (Switch) routing with a fixed per-expert
+  capacity: dispatch and combine are dense one-hot tensors, the expert
+  compute is three einsums — no gather/scatter, no dynamic shapes, all MXU
+  work. Tokens past an expert's capacity are dropped (contribute zero; the
+  caller's residual connection passes them through), the standard Switch
+  trade.
+- **Group-wise routing.** Each batch row routes independently with capacity
+  ``C = ceil(S/E * capacity_factor)``, so the (group, S, E, C) routing
+  tensors stay LINEAR in total tokens (a single global routing pool would
+  be quadratic and OOM at real sequence lengths).
+- **Padding-aware.** Masked tokens never claim expert capacity and don't
+  drive the load-balancing aux loss — otherwise pad tokens evict real ones
+  first-come-first-served and the router trains on garbage embeddings.
+- **EP via shardings, not hand-written collectives.** The expert dim of the
+  expert buffers and the ``(E, D, F)`` weights shards over the mesh's
+  "model" axis (see ``tpuserve.train.TRAIN_PARTITION_RULES``); XLA lowers
+  the dispatch/combine einsums to the token all-to-alls over ICI. The op
+  stays a pure function — the same code runs 1-device and expert-parallel.
+
+Reference: Switch Transformer (Fedus et al. 2021) routing math, re-derived
+for the static-shape formulation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def switch_route(logits: jax.Array, capacity: int,
+                 token_mask: jax.Array | None = None):
+    """Top-1 routing of ONE group -> static (T, E, C) dispatch/combine.
+
+    ``token_mask`` (T,): 0-tokens (padding) never claim capacity and are
+    excluded from the aux statistics. Returns (dispatch, combine, aux):
+    ``dispatch`` is 0/1 routing of token t to (expert e, queue slot c);
+    ``combine`` additionally carries the gate probability; ``aux`` is the
+    load-balancing loss (fraction-routed x gate mass per expert, scaled by
+    E — Switch eq. 4).
+    """
+    n_experts = logits.shape[-1]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    expert = jnp.argmax(gates, axis=-1)                          # (T,)
+    gate = jnp.max(gates, axis=-1)                               # (T,)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=gates.dtype)
+    if token_mask is None:
+        token_mask = jnp.ones(logits.shape[0], gates.dtype)
+    token_mask = token_mask.astype(gates.dtype)
+    onehot = onehot * token_mask[:, None]
+    # Position of each token in its expert's queue, -1 where unrouted.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0
+    pos = jnp.max(pos, axis=-1).astype(jnp.int32)                # (T,)
+    keep = pos >= 0
+    keep &= pos < capacity
+    dispatch = (onehot * keep[:, None])[..., None] * jax.nn.one_hot(
+        jnp.clip(pos, 0, capacity - 1), capacity, dtype=gates.dtype)[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    # Load-balance aux over REAL tokens only (differentiable via the gates).
+    n_real = jnp.maximum(token_mask.sum(), 1.0)
+    frac_routed = onehot.sum(axis=0) / n_real
+    gate_mass = (gates * token_mask[:, None]).sum(axis=0) / n_real
+    aux = n_experts * jnp.sum(frac_routed * gate_mass)
+    return dispatch, combine, aux
+
+
+class SwitchFFN(nn.Module):
+    """Drop-in MoE replacement for a transformer FFN block.
+
+    Expert weights carry a leading (E, ...) dim; shard it on "model" for
+    expert parallelism. bf16-safe: routing softmax/argmax in f32.
+    """
+
+    experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array,
+                 mask: jax.Array | None = None) -> tuple[jax.Array, jax.Array]:
+        b, s, d = x.shape
+        # Per-group (batch-row) routing keeps the (b, s, E, C) routing
+        # tensors linear in total tokens.
+        capacity = int(math.ceil(s / self.experts * self.capacity_factor))
+        router = self.param("router", nn.initializers.normal(0.02),
+                            (d, self.experts))
+        w_up = self.param("w_up", nn.initializers.normal(0.02),
+                          (self.experts, d, self.d_ff))
+        w_down = self.param("w_down", nn.initializers.normal(0.02),
+                            (self.experts, self.d_ff, d))
+        logits = jnp.einsum("gsd,de->gse", x.astype(jnp.float32),
+                            router.astype(jnp.float32))
+        if mask is None:
+            mask = jnp.ones((b, s), jnp.float32)
+        dispatch, combine, aux = jax.vmap(
+            lambda lg, mg: switch_route(lg, capacity, mg))(logits, mask)
+        dispatch = dispatch.astype(self.dtype)   # (g, s, E, C)
+        combine = combine.astype(self.dtype)
+        xe = jnp.einsum("gsec,gsd->gecd", dispatch, x.astype(self.dtype))
+        h = nn.gelu(jnp.einsum("gecd,edf->gecf", xe, w_up.astype(self.dtype)))
+        ye = jnp.einsum("gecf,efd->gecd", h, w_down.astype(self.dtype))
+        y = jnp.einsum("gsec,gecd->gsd", combine, ye)
+        # Token-weighted aux: fully/mostly padded rows must not dilute the
+        # balance pressure.
+        n_real = mask.astype(jnp.float32).sum(axis=1)
+        aux = jnp.sum(aux * n_real) / jnp.maximum(jnp.sum(n_real), 1.0)
+        return y.astype(x.dtype), aux

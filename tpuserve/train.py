@@ -49,6 +49,11 @@ class TrainConfig:
     # "ring" (K/V ppermute rotation, tpuserve.ops.ring_attention), or
     # "ulysses" (head all-to-all, tpuserve.ops.ulysses).
     seq_attention: str = "dense"
+    # Mixture-of-experts FFN: 0 = dense MLP; N > 0 = Switch top-1 routing
+    # over N experts (tpuserve.ops.moe), expert dim sharded on "model" (EP).
+    moe_experts: int = 0
+    moe_capacity: float = 1.25
+    moe_aux_weight: float = 0.01
 
 
 class Block(nn.Module):
@@ -57,7 +62,7 @@ class Block(nn.Module):
     mesh: Any = None  # required when cfg.seq_attention != "dense"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mask=None):
         c = self.cfg
         attention_fn = nn.dot_product_attention
         if c.seq_attention != "dense":
@@ -88,9 +93,19 @@ class Block(nn.Module):
                                             attention_fn=attention_fn)(h)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        h = nn.Dense(c.d_ff, dtype=self.dtype, name="up")(h)
-        h = nn.gelu(h)
-        h = nn.Dense(c.d_model, dtype=self.dtype, name="down")(h)
+        if c.moe_experts:
+            from tpuserve.ops.moe import SwitchFFN
+
+            # mask: pad tokens must not claim expert capacity or drive the
+            # balance loss.
+            h, aux = SwitchFFN(c.moe_experts, c.d_ff,
+                               capacity_factor=c.moe_capacity,
+                               dtype=self.dtype, name="moe")(h, mask)
+            self.sow("losses", "moe_aux", aux)
+        else:
+            h = nn.Dense(c.d_ff, dtype=self.dtype, name="up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(c.d_model, dtype=self.dtype, name="down")(h)
         return x + h
 
 
@@ -100,7 +115,7 @@ class TransformerLM(nn.Module):
     mesh: Any = None
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, mask=None):
         c = self.cfg
         x = nn.Embed(c.vocab, c.d_model, dtype=self.dtype, name="embed")(tokens)
         pos = self.param("pos_embed", nn.initializers.normal(0.02), (c.max_seq, c.d_model))
@@ -109,17 +124,20 @@ class TransformerLM(nn.Module):
         if c.remat:
             block = nn.remat(Block)
         for i in range(c.n_layers):
-            x = block(c, dtype=self.dtype, mesh=self.mesh, name=f"block{i}")(x)
+            x = block(c, dtype=self.dtype, mesh=self.mesh, name=f"block{i}")(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         return nn.Dense(c.vocab, dtype=jnp.float32, name="lm_head")(x)
 
 
 # Tensor-parallel rules: attention QKV/out and MLP kernels split on "model";
-# embeddings split on the vocab dim; everything else replicated.
+# embeddings split on the vocab dim; MoE expert dims split on "model" (EP:
+# each device holds E/tp experts, XLA inserts the token all-to-alls);
+# everything else replicated.
 TRAIN_PARTITION_RULES: list[tuple[str, P]] = [
     (r"embed/embedding", P("model", None)),
     (r"attn/(query|key|value)/kernel", P(None, "model", None)),
     (r"attn/out/kernel", P("model", None, None)),
+    (r"moe/w_(up|down)", P("model", None, None)),
     (r"up/kernel", P(None, "model")),
     (r"down/kernel", P("model", None)),
     (r"lm_head/kernel", P(None, "model")),
@@ -146,9 +164,14 @@ def make_train_state(mesh: Mesh, cfg: TrainConfig, rng: jax.Array | None = None)
 
 
 def loss_fn(model, params, tokens, targets, mask):
-    logits = model.apply({"params": params}, tokens)
+    logits, mods = model.apply({"params": params}, tokens, mask,
+                               mutable=["losses"])
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-    return (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    loss = (losses * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    # MoE load-balancing aux (zero-leaved when no MoE blocks sowed).
+    aux = sum(jnp.sum(v) for v in
+              jax.tree_util.tree_leaves(mods.get("losses", {})))
+    return loss + model.cfg.moe_aux_weight * aux
 
 
 def make_train_step(model, tx, mesh: Mesh, param_shardings):
