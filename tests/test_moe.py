@@ -9,7 +9,6 @@ train step runs with the expert dim really sharded over "model" (EP).
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tpuserve.ops.moe import SwitchFFN, switch_route
 

@@ -20,11 +20,6 @@ and ``tpuserve.ops.ulysses`` (head all-to-all).
 """
 
 from tpuserve.parallel.distributed import init_distributed, process_info  # noqa: F401
-from tpuserve.parallel.pipeline import (  # noqa: F401
-    make_stage_mesh,
-    pipeline_forward,
-    stack_stage_params,
-)
 from tpuserve.parallel.mesh import (  # noqa: F401
     MeshPlan,
     host_major_grid,
@@ -32,6 +27,11 @@ from tpuserve.parallel.mesh import (  # noqa: F401
     batch_sharding,
     replicated_sharding,
     local_device_count,
+)
+from tpuserve.parallel.pipeline import (  # noqa: F401
+    make_stage_mesh,
+    pipeline_forward,
+    stack_stage_params,
 )
 from tpuserve.parallel.partition import (  # noqa: F401
     match_partition_rules,

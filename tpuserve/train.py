@@ -287,12 +287,15 @@ def dryrun(devices: list, steps: int = 1) -> float:
 
     When the mesh has a real "seq" axis (sp > 1), attention runs through
     tpuserve.ops.ring_attention so the dry run exercises genuine sequence
-    parallelism (K/V ppermute around the ring), alongside DP and TP.
+    parallelism (K/V ppermute around the ring), alongside DP and TP. When
+    the "model" axis is real (tp > 1), the FFN runs as a Switch MoE with
+    the expert dim sharded over it — expert parallelism in the same step.
     """
     n = len(devices)
     plan = mesh_plan_for(n)
     mesh = make_mesh(plan, devices=devices)
-    cfg = TrainConfig(seq_attention="ring" if plan.sp > 1 else "dense")
+    cfg = TrainConfig(seq_attention="ring" if plan.sp > 1 else "dense",
+                      moe_experts=2 * plan.tp if plan.tp > 1 else 0)
     model, params, tx, opt_state, shardings = make_train_state(mesh, cfg)
     step, _ = make_train_step(model, tx, mesh, shardings)
     batch_size = max(4, 2 * mesh.shape["data"])
