@@ -163,13 +163,8 @@ def main() -> int:
     mode = os.environ.get("BENCH_MODE", "direct")
     wire_format = os.environ.get("BENCH_WIRE_FORMAT", "yuv420")
     wire = int(env_f("BENCH_WIRE", 160))
-    buckets = [int(b) for b in os.environ.get("BENCH_BUCKETS", "128,256").split(",")]
     duration = env_f("BENCH_DURATION", 20)
     warmup = env_f("BENCH_WARMUP", 6)
-    concurrency = int(env_f("BENCH_CONCURRENCY", 384))
-
-    print(f"# config: mode={mode} wire={wire_format}@{wire} buckets={buckets}",
-          file=sys.stderr)
 
     link_mbps = measure_link_rate_mbps()
     bpp = 1.5 if wire_format == "yuv420" else 3.0
@@ -177,6 +172,26 @@ def main() -> int:
     ceiling = link_mbps * 1e6 / img_bytes if link_mbps else float("nan")
     print(f"# link: {link_mbps} MB/s real sustained; wire {img_bytes} B/img "
           f"-> wire-bound ceiling {ceiling:.0f} img/s", file=sys.stderr)
+
+    # Batch buckets and loadgen concurrency adapt to the measured link unless
+    # pinned: the tunnel swings 2-25 MB/s hour to hour, and when it is slow a
+    # 256-wide bucket is ~5 s of wire per batch — pure queueing (the chip is
+    # idle either way), no throughput. Size the top bucket to ~0.5 s of wire
+    # and keep ~3 batches in flight.
+    if "BENCH_BUCKETS" in os.environ:
+        buckets = [int(b) for b in os.environ["BENCH_BUCKETS"].split(",")]
+    else:
+        top = 8
+        if ceiling > 0:
+            while top * 2 <= min(256, ceiling * 0.5):
+                top *= 2
+        else:
+            top = 256
+        buckets = sorted({max(8, top // 2), top})
+    concurrency = int(env_f("BENCH_CONCURRENCY", min(384, max(32, 3 * max(buckets)))))
+
+    print(f"# config: mode={mode} wire={wire_format}@{wire} buckets={buckets} "
+          f"concurrency={concurrency}", file=sys.stderr)
 
     t0 = time.time()
     state, cfg = build_state(mode, wire_format, wire, buckets)

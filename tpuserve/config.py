@@ -144,6 +144,9 @@ class ServerConfig:
     compilation_cache_dir: str = ""
     # Validate-on-startup canary (tiny inference per model) on/off.
     startup_canary: bool = True
+    # Run every compiled executable once at startup so first requests don't
+    # pay PJRT program load (runtime.ModelRuntime.prewarm).
+    prewarm_executables: bool = True
     # Observability: max request-trace events kept for /debug/trace.
     trace_capacity: int = 65536
 

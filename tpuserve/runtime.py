@@ -220,6 +220,33 @@ class ModelRuntime:
         """Block for D2H; call off the event loop."""
         return jax.tree_util.tree_map(np.asarray, outputs)
 
+    def prewarm(self) -> None:
+        """Execute every (bucket, replica) once on zeros and block for it.
+
+        Compiling does not load the program onto the device: the first real
+        execution pays PJRT program load (~20 s per executable through the
+        dev tunnel, BASELINE.md "Link physics"). Paying that at startup keeps
+        it off the first real request's latency and out of any measurement
+        window.
+        """
+        t0 = time.perf_counter()
+        pending = []
+        for bucket, exes in sorted(self.executables.items()):
+            struct = self.model.input_signature(bucket)
+            host = jax.tree_util.tree_map(
+                lambda s: np.zeros(s.shape, s.dtype), struct)
+            # Dispatch everything async first so loads on distinct devices
+            # overlap; then one D2H fetch per executable. The readback is NOT
+            # optional: on the tunneled dev TPU, block_until_ready returns
+            # before remote execution finishes (BASELINE.md "Timing caveats"),
+            # so only a dependent read proves the program load completed.
+            pending.extend(self.run(bucket, host, replica=i)
+                           for i in range(len(exes)))
+        for out in pending:
+            self.fetch(out)
+        log.info("%s: prewarmed %d executable(s) in %.1fs",
+                 self.model.name, len(pending), time.perf_counter() - t0)
+
     # -- weight reload -------------------------------------------------------
     def reload_params(self) -> dict:
         """Hot-swap weights from cfg.weights without recompiling.

@@ -82,6 +82,8 @@ class ServerState:
                     rt.prewarm()
                 else:
                     rt = build_runtime(model, pool=compile_pool)
+                    if self.cfg.prewarm_executables:
+                        rt.prewarm()
                 self.models[mcfg.name] = model
                 self.runtimes[mcfg.name] = rt
                 log.info("model %s ready in %.1fs: %s", mcfg.name, time.perf_counter() - t0, rt.describe())
