@@ -272,3 +272,38 @@ def test_admin_reload_endpoint(client):
         assert missing.status == 404
 
     run(go())
+
+
+def test_class_labels_in_responses(tmp_path, loop):
+    """cfg.labels maps class indices to names in classify responses and
+    shows up in the /v1/models inventory. CRLF endings and trailing blank
+    lines must not corrupt the label values."""
+    labels = tmp_path / "labels.txt"
+    labels.write_bytes("".join(f"name-{i}\r\n" for i in range(10)).encode() + b"\n")
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single", request_timeout_ms=10_000.0,
+                            labels=str(labels))],
+        decode_threads=2,
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/models/toy:classify", data=toy_image(),
+                                  headers={"Content-Type": "application/x-npy"})
+            assert r.status == 200
+            body = await r.json()
+            for entry in body["top_k"]:
+                assert entry["label"] == f"name-{entry['class']}"
+            inv = await (await client.get("/v1/models")).json()
+            assert inv["toy"]["labels"] == str(labels)
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())

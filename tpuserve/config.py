@@ -34,6 +34,10 @@ class ModelConfig:
     # Optional path to weights: a TF SavedModel dir, a frozen GraphDef .pb,
     # or an orbax checkpoint dir. None => seeded random init (no-network dev).
     weights: str | None = None
+    # Optional path to a class-label file (one name per line, in class-index
+    # order, e.g. ImageNet synset names). classify/detect responses then
+    # carry a human-readable "label" next to each class index.
+    labels: str | None = None
     # Static batch-size buckets, ascending. Each (bucket, input-shape) pair is
     # AOT-compiled to its own XLA executable at startup.
     batch_buckets: list[int] = field(default_factory=lambda: [1, 4, 8, 16, 32])

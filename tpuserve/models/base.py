@@ -45,6 +45,13 @@ class ServingModel(abc.ABC):
     def __init__(self, cfg: ModelConfig) -> None:
         self.cfg = cfg
         self.name = cfg.name
+        self.class_labels: list[str] | None = None
+        if cfg.labels:
+            with open(cfg.labels, encoding="utf-8") as f:
+                lines = [line.rstrip("\r\n") for line in f]
+            while lines and not lines[-1]:  # trailing blank lines
+                lines.pop()
+            self.class_labels = lines
 
     # -- parameters ---------------------------------------------------------
     @abc.abstractmethod
@@ -132,20 +139,27 @@ class ServingModel(abc.ABC):
     def host_postprocess(self, outputs: Outputs, n_valid: int) -> list[Any]:
         """Convert device outputs (already np) to n_valid JSON-able results."""
 
-    @staticmethod
-    def format_top_k(outputs: dict, n_valid: int) -> list[dict]:
-        """Shared classifier response shape: {"top_k": [{class, prob}, ...]}."""
+    def format_top_k(self, outputs: dict, n_valid: int) -> list[dict]:
+        """Shared classifier response shape: {"top_k": [{class, prob}, ...]},
+        plus a "label" per entry when cfg.labels names the classes."""
         probs = outputs["probs"][:n_valid]
         idx = outputs["indices"][:n_valid]
         return [
-            {
-                "top_k": [
-                    {"class": int(i), "prob": float(p)}
-                    for i, p in zip(idx[r], probs[r])
-                ]
-            }
+            {"top_k": [self._class_entry(i, p) for i, p in zip(idx[r], probs[r])]}
             for r in range(n_valid)
         ]
+
+    def _class_entry(self, i, p) -> dict:
+        entry = {"class": int(i), "prob": float(p)}
+        label = self.label_for(int(i))
+        if label is not None:
+            entry["label"] = label
+        return entry
+
+    def label_for(self, i: int) -> str | None:
+        if self.class_labels is not None and 0 <= i < len(self.class_labels):
+            return self.class_labels[i]
+        return None
 
     def assemble(self, items: list[Any], bucket: tuple) -> HostBatch:
         """Stack decoded items into one padded host batch for `bucket`.

@@ -488,11 +488,15 @@ class EfficientDetServing(ImageClassifierServing):
             for j in range(self.max_dets):
                 if outputs["classes"][r][j] < 0:
                     continue
-                dets.append({
+                det = {
                     "box": [round(float(c), 5) for c in outputs["boxes"][r][j]],
                     "score": round(float(outputs["scores"][r][j]), 5),
                     "class": int(outputs["classes"][r][j]),
-                })
+                }
+                label = self.label_for(det["class"])
+                if label is not None:
+                    det["label"] = label
+                dets.append(det)
                 if len(dets) == n:
                     break
             res.append({"detections": dets, "num_detections": n})

@@ -293,6 +293,7 @@ class ModelRuntime:
             # random weights (None) vs a real artifact, and per-family options
             # like BERT's attention impl.
             "weights": self.cfg.weights,
+            "labels": self.cfg.labels,
             "options": dict(self.cfg.options),
             "replicas": len(self.meshes),
             "mesh_shape": dict(self.meshes[0].shape),
