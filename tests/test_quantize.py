@@ -1,0 +1,156 @@
+"""Weight-only int8 quantization (tpuserve/quantize.py): numerics, spec
+mirroring for tensor parallelism, and the end-to-end serving path."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuserve import quantize as qz
+from tpuserve.config import ModelConfig
+from tpuserve.models import build
+from tpuserve.runtime import build_runtime
+
+
+def test_roundtrip_error_bounded_per_channel():
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 0.3, (64, 96)).astype(np.float32)
+    q = qz.quantize_leaf(w)
+    assert q[qz.QKEY].dtype == np.int8 and q[qz.QKEY].shape == w.shape
+    assert q[qz.SKEY].shape == (1, 96)
+    deq = q[qz.QKEY].astype(np.float32) * q[qz.SKEY]
+    # Symmetric rounding: error <= scale/2 per element, channel-wise.
+    assert (np.abs(deq - w) <= q[qz.SKEY] / 2 + 1e-7).all()
+
+
+def test_depthwise_uses_second_to_last_axis():
+    w = np.random.default_rng(1).normal(size=(3, 3, 512, 1)).astype(np.float32)
+    q = qz.quantize_leaf(w)
+    assert q[qz.SKEY].shape == (1, 1, 512, 1)
+
+
+def test_small_int_and_1d_leaves_untouched():
+    tree = {
+        "kernel": np.zeros((128, 64), np.float32),
+        "bias": np.zeros((64,), np.float32),
+        "small": np.zeros((4, 4), np.float32),
+        "table": np.zeros((128, 64), np.int32),
+    }
+    out = qz.quantize_tree(tree, min_size=1024)
+    assert qz.is_quantized(out["kernel"])
+    assert out["bias"] is tree["bias"]
+    assert out["small"] is tree["small"]
+    assert out["table"] is tree["table"]
+
+
+def test_zero_weight_channel_dequantizes_to_zero():
+    w = np.zeros((64, 64), np.float32)
+    q = qz.quantize_leaf(w)
+    assert (q[qz.QKEY] == 0).all() and (q[qz.SKEY] == 1.0).all()
+
+
+def test_quantize_specs_mirror_tp_sharding():
+    params = {
+        "up": np.zeros((256, 128), np.float32),    # TP on last axis
+        "down": np.zeros((128, 256), np.float32),  # TP on first axis
+        "bias": np.zeros((128,), np.float32),
+    }
+    specs = {"up": P(None, "model"), "down": P("model", None), "bias": P()}
+    out = qz.quantize_specs(params, specs, min_size=1024)
+    assert out["up"] == {qz.QKEY: P(None, "model"), qz.SKEY: P(None, "model")}
+    # down's channel axis is the last (unsharded) one; its scale replicates.
+    assert out["down"] == {qz.QKEY: P("model", None), qz.SKEY: P(None, None)}
+    assert out["bias"] == P()
+
+
+def test_dequantize_tree_matches_numpy():
+    rng = np.random.default_rng(2)
+    tree = {"k": rng.normal(size=(64, 80)).astype(np.float32),
+            "b": rng.normal(size=(80,)).astype(np.float32)}
+    qtree = qz.quantize_tree(tree, min_size=1024)
+    deq = jax.jit(lambda t: qz.dequantize_tree(t, np.float32))(qtree)
+    ref = qtree["k"][qz.QKEY].astype(np.float32) * qtree["k"][qz.SKEY]
+    np.testing.assert_allclose(np.asarray(deq["k"]), ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(deq["b"]), tree["b"], rtol=1e-6)
+
+
+def _toy_cfg(**kw) -> ModelConfig:
+    return ModelConfig(name="toy", family="toy", batch_buckets=[2],
+                       dtype="float32", num_classes=10, parallelism="single",
+                       **kw)
+
+
+def test_end_to_end_toy_matches_fp_serving():
+    """Quantized serving agrees with full-precision serving on the same
+    weights, and the compiled params really are int8."""
+    img = np.random.default_rng(3).integers(0, 255, (8, 8, 3), np.uint8)
+
+    def run(cfg):
+        model = build(cfg)
+        rt = build_runtime(model)
+        bucket = model.buckets()[0]
+        batch = model.assemble([img], bucket)
+        return rt, rt.fetch(rt.run(bucket, batch))
+
+    rt_fp, out_fp = run(_toy_cfg())
+    rt_q, out_q = run(_toy_cfg(quantize="int8", quantize_min_size=1024))
+
+    leaves = jax.tree_util.tree_leaves(rt_q.params_per_mesh[0])
+    assert any(x.dtype == np.int8 for x in leaves), "nothing was quantized"
+    np.testing.assert_allclose(out_q["probs"], out_fp["probs"], atol=5e-3)
+    # Top-1 agreement.
+    assert out_q["indices"][0][0] == out_fp["indices"][0][0]
+
+
+def test_tp_sharded_quantized_bert_runs():
+    """int8 weights + TP: scales shard with their weights over the model
+    axis and the forward stays finite (8 fake CPU devices)."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs multi-device mesh")
+    from tpuserve.parallel import make_mesh
+    from tpuserve.parallel.mesh import MeshPlan
+
+    mesh = make_mesh(MeshPlan(tp=2), devices=jax.devices()[:4])
+    cfg = ModelConfig(
+        name="bert", family="bert", parallelism="sharded", tp=2,
+        batch_buckets=[2], seq_buckets=[16], dtype="float32", num_classes=4,
+        quantize="int8", quantize_min_size=256,
+        options={"layers": 1, "d_model": 32, "heads": 2, "d_ff": 64,
+                 "vocab_size": 512},
+    )
+    model = build(cfg)
+    rt = build_runtime(model, mesh=mesh)
+    (bucket,) = rt.executables
+    item = model.host_decode(b'{"text": "quantized tensor parallel"}',
+                             "application/json")
+    out = rt.fetch(rt.run(bucket, model.assemble([item, item], bucket)))
+    assert np.isfinite(out["probs"]).all()
+
+
+def test_recycle_mode_with_int8_weights():
+    """Regression: the deferred worker must compile the dequant-wrapped
+    forward, not raw model.forward, when weights are stored int8."""
+    import asyncio
+
+    from tpuserve.deferred import DeferredPool
+
+    cfg = ModelConfig(
+        name="toy", family="toy", batch_buckets=[2], deadline_ms=10.0,
+        dtype="float32", num_classes=10, parallelism="single",
+        session_mode="recycle", relay_workers=1, relay_slots=2,
+        relay_epoch_images=4, relay_epoch_ms=300.0,
+        request_timeout_ms=30_000.0, quantize="int8", quantize_min_size=1024,
+    )
+    model = build(cfg)
+    pool = DeferredPool(cfg, "", model)
+    pool.prewarm()
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(pool.start())
+    try:
+        imgs = np.random.default_rng(5).integers(0, 255, (2, 8, 8, 3), np.uint8)
+
+        out = loop.run_until_complete(pool.run_deferred((2,), np.asarray(imgs)))
+        assert np.isfinite(out["probs"]).all()
+    finally:
+        loop.run_until_complete(pool.stop())
+        loop.close()

@@ -52,6 +52,13 @@ class ModelConfig:
     request_timeout_ms: float = 2000.0
     # Compute dtype for params/activations on device.
     dtype: str = "bfloat16"
+    # Weight-only quantization: "int8" stores large weights as int8 +
+    # per-channel scales and dequantizes inside the compiled forward (halves
+    # HBM weight streaming and upload bytes; see tpuserve.quantize). None =
+    # full compute-dtype weights.
+    quantize: str | None = None
+    # Float leaves smaller than this stay unquantized (biases, norms).
+    quantize_min_size: int = 4096
     # Image input edge (H == W) for vision models.
     image_size: int = 224
     # Host->device wire shape edge for images: host decodes to (wire, wire, 3)

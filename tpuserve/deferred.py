@@ -114,8 +114,11 @@ def _worker_run(mcfg, cache_dir, conn, batch_shm_name, slot_bytes, cap_rows) -> 
     params = rt.params_per_mesh[0]
 
     # Output row structure (shapes past the batch dim are bucket-independent).
+    # _forward_fn, not model.forward: quantized params carry {"q8", "q8_scale"}
+    # dict leaves the raw forward cannot consume.
+    fwd = rt._forward_fn()
     sample_sig = model.input_signature(model.buckets()[0])
-    out_struct = jax.eval_shape(model.forward, params, sample_sig)
+    out_struct = jax.eval_shape(fwd, params, sample_sig)
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out_struct)
 
     acc = [
@@ -134,7 +137,7 @@ def _worker_run(mcfg, cache_dir, conn, batch_shm_name, slot_bytes, cap_rows) -> 
     for bucket in model.buckets():
         sig = model.input_signature(bucket)
         bstruct = jax.tree_util.tree_flatten(
-            jax.eval_shape(model.forward, params, sig))[0]
+            jax.eval_shape(fwd, params, sig))[0]
         appends[bucket] = (
             jax.jit(_append, donate_argnums=(0,))
             .lower(acc_struct, bstruct, jax.ShapeDtypeStruct((), jnp.int32))

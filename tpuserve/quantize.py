@@ -1,0 +1,112 @@
+"""Weight-only int8 quantization for serving (SURVEY.md §2 C5).
+
+``ModelConfig.quantize = "int8"`` stores every large floating-point weight as
+int8 plus a per-channel float32 scale and dequantizes inside the compiled
+forward. XLA fuses the ``convert + multiply`` into the consuming matmul/conv,
+so weights stream from HBM at half the bf16 byte count — the classic
+weight-only quantization win for bandwidth-bound small-batch serving — and
+param upload/checkpoint size halves with them. The MXU still computes in the
+model's compute dtype; activations are untouched.
+
+Scheme: symmetric per-channel absmax. For a weight ``w`` the channel axis is
+its last axis (or the second-to-last when the last is size 1, e.g. depthwise
+conv kernels); ``scale = absmax(w, other_axes, keepdims) / 127`` and
+``q = round(w / scale)``. Keeping the scale's singleton dims makes dequant a
+plain broadcast multiply and lets tensor-parallel PartitionSpecs transfer
+axis-by-axis (see ``quantize_specs``). Small (< min_size), integer, and 0/1-D
+leaves stay unquantized — biases, norms, and scalars are not worth the
+fidelity risk.
+
+Quality is the usual weight-only tradeoff (sub-percent top-1 movement on
+conv/transformer classifiers); it is opt-in per model and off by default.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# Marker keys for a quantized leaf's sub-tree.
+QKEY = "q8"
+SKEY = "q8_scale"
+
+# Leaves smaller than this stay in the compute dtype.
+DEFAULT_MIN_SIZE = 4096
+
+
+def _channel_axis(shape: tuple[int, ...]) -> int:
+    return len(shape) - 1 if shape[-1] > 1 else max(len(shape) - 2, 0)
+
+
+def eligible(leaf: Any, min_size: int = DEFAULT_MIN_SIZE) -> bool:
+    """True when a param leaf should be quantized."""
+    shape = getattr(leaf, "shape", ())
+    dtype = getattr(leaf, "dtype", None)
+    return (
+        dtype is not None
+        and jnp.issubdtype(dtype, jnp.floating)
+        and len(shape) >= 2
+        and int(np.prod(shape)) >= min_size
+    )
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and QKEY in leaf and SKEY in leaf
+
+
+def quantize_leaf(w: np.ndarray) -> dict[str, np.ndarray]:
+    """Symmetric per-channel int8: {"q8": int8 w-like, "q8_scale": f32}."""
+    w = np.asarray(w, dtype=np.float32)
+    axis = _channel_axis(w.shape)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    absmax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = (absmax / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, np.float32(1.0), scale)
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return {QKEY: q, SKEY: scale}
+
+
+def quantize_tree(params: Any, min_size: int = DEFAULT_MIN_SIZE) -> Any:
+    """Replace every eligible leaf with its quantized {"q8", "q8_scale"}."""
+    return jax.tree_util.tree_map(
+        lambda x: quantize_leaf(np.asarray(x)) if eligible(x, min_size) else x,
+        params,
+    )
+
+
+def quantize_specs(params: Any, specs: Any,
+                   min_size: int = DEFAULT_MIN_SIZE) -> Any:
+    """Mirror ``quantize_tree`` on a PartitionSpec tree.
+
+    The int8 values keep the weight's spec (same shape). The keepdims scale
+    keeps the spec entry of its channel axis and replicates every reduced
+    (now size-1) axis, so a tensor-parallel weight's scale shards with it.
+    """
+
+    def one(leaf: Any, spec: P) -> Any:
+        if not eligible(leaf, min_size):
+            return spec
+        ndim = len(leaf.shape)
+        axis = _channel_axis(leaf.shape)
+        full = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+        scale_spec = P(*[full[i] if i == axis else None for i in range(ndim)])
+        return {QKEY: spec, SKEY: scale_spec}
+
+    # tree_map flattens `specs` only down to `params`' leaf positions
+    # (flatten_up_to), so each P arrives intact even though P is a tuple.
+    return jax.tree_util.tree_map(one, params, specs)
+
+
+def dequantize_tree(params: Any, dtype: Any) -> Any:
+    """Jittable: restore quantized leaves to ``dtype`` (broadcast multiply);
+    XLA fuses this into each weight's consumer."""
+    return jax.tree_util.tree_map(
+        lambda x: (x[QKEY].astype(dtype) * x[SKEY].astype(dtype))
+        if is_quantized(x) else x,
+        params,
+        is_leaf=is_quantized,
+    )
