@@ -9,6 +9,7 @@ train step runs with the expert dim really sharded over "model" (EP).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpuserve.ops.moe import SwitchFFN, switch_route
 
@@ -115,3 +116,89 @@ def test_padding_never_claims_capacity():
     np.testing.assert_allclose(np.asarray(d_full)[:4], np.asarray(d_pref))
     np.testing.assert_allclose(np.asarray(c_full)[:4], np.asarray(c_pref))
     assert float(np.asarray(d_full)[4:].sum()) == 0.0  # pads claim nothing
+
+
+def test_moe_bert_serves_single_device():
+    """options.moe_experts makes the bert family serve a Switch-MoE FFN;
+    padded lanes must not perturb real lanes (per-row routing + token
+    masking)."""
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+    from tpuserve.runtime import build_runtime
+
+    cfg = ModelConfig(
+        name="moe-bert", family="bert", parallelism="single",
+        batch_buckets=[4], seq_buckets=[16], dtype="float32", num_classes=4,
+        options={"layers": 1, "d_model": 32, "heads": 2, "d_ff": 64,
+                 "vocab_size": 512, "moe_experts": 4},
+    )
+    model = build(cfg)
+    rt = build_runtime(model)
+    (bucket,) = rt.executables
+    item = model.host_decode(b'{"text": "mixture of experts"}',
+                             "application/json")
+    out1 = rt.fetch(rt.run(bucket, model.assemble([item], bucket)))
+    out2 = rt.fetch(rt.run(bucket, model.assemble([item, item, item], bucket)))
+    assert np.isfinite(out1["probs"]).all()
+    # Row 0's result must not depend on how many padded lanes ride along.
+    np.testing.assert_allclose(out1["probs"][0], out2["probs"][0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_moe_bert_expert_parallel_sharded():
+    """EP serving: expert weights shard over the mesh's model axis and the
+    sharded forward matches the single-device reference."""
+    import jax
+
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+    from tpuserve.parallel import make_mesh
+    from tpuserve.parallel.mesh import MeshPlan
+    from tpuserve.runtime import build_runtime
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs multi-device mesh")
+    mesh = make_mesh(MeshPlan(tp=2), devices=jax.devices()[:4])
+    cfg = ModelConfig(
+        name="moe-bert", family="bert", parallelism="sharded", tp=2,
+        batch_buckets=[2], seq_buckets=[16], dtype="float32", num_classes=4,
+        options={"layers": 1, "d_model": 32, "heads": 2, "d_ff": 64,
+                 "vocab_size": 512, "moe_experts": 4},
+    )
+    model = build(cfg)
+    rt = build_runtime(model, mesh=mesh)
+    # The (E, D, F) expert weights really are sharded on "model".
+    from tpuserve.parallel.partition import named_leaves
+
+    w_up = [leaf for name, leaf in named_leaves(rt.params_per_mesh[0])
+            if "moe/w_up" in name]
+    assert w_up and "model" in str(w_up[0].sharding.spec)
+    (bucket,) = rt.executables
+    item = model.host_decode(b'{"text": "expert parallel serving"}',
+                             "application/json")
+    out = rt.fetch(rt.run(bucket, model.assemble([item, item], bucket)))
+    assert np.isfinite(out["probs"]).all()
+
+
+def test_moe_experts_must_divide_tp():
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    with pytest.raises(ValueError, match="divide"):
+        build(ModelConfig(
+            name="bad", family="bert", parallelism="sharded", tp=2,
+            batch_buckets=[2], seq_buckets=[16], num_classes=4,
+            options={"layers": 1, "d_model": 32, "heads": 2, "d_ff": 64,
+                     "vocab_size": 512, "moe_experts": 3}))
+
+
+def test_moe_experts_rejects_tf_weights():
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    with pytest.raises(ValueError, match="moe_experts cannot be combined"):
+        build(ModelConfig(
+            name="bad", family="bert", weights="/nonexistent/savedmodel",
+            batch_buckets=[2], seq_buckets=[16], num_classes=4,
+            options={"layers": 1, "d_model": 32, "heads": 2, "d_ff": 64,
+                     "vocab_size": 512, "moe_experts": 4}))

@@ -154,3 +154,12 @@ def test_recycle_mode_with_int8_weights():
     finally:
         loop.run_until_complete(pool.stop())
         loop.close()
+
+
+def test_quantize_tree_is_idempotent():
+    tree = {"k": np.random.default_rng(6).normal(size=(4096, 8)).astype(np.float32)}
+    once = qz.quantize_tree(tree, min_size=1024)
+    twice = qz.quantize_tree(once, min_size=1)  # would re-quantize any leaf
+    assert qz.is_quantized(twice["k"])
+    np.testing.assert_array_equal(twice["k"][qz.QKEY], once["k"][qz.QKEY])
+    np.testing.assert_array_equal(twice["k"][qz.SKEY], once["k"][qz.SKEY])

@@ -524,7 +524,9 @@ class DeferredPool:
             "family": self.mcfg.family,
             "mode": "recycle",
             "dtype": self.mcfg.dtype,
+            "quantize": self.mcfg.quantize,
             "weights": self.mcfg.weights,
+            "labels": self.mcfg.labels,
             "options": dict(self.mcfg.options),
             "workers_alive": len([w for w in self._workers if w.proc.is_alive()]),
             "warm": len(self._warm),

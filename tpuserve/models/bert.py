@@ -47,6 +47,10 @@ class BertBlock(nn.Module):
     attention_impl: str = "dense"
     ln_eps: float = 1e-12  # original BERT value; keeps imported weights exact
     mesh: Any = None  # required for "ring" / "ulysses"
+    # > 0: replace the dense FFN with a Switch MoE over this many experts
+    # (tpuserve.ops.moe); expert dims shard on "model" for EP serving.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -89,11 +93,22 @@ class BertBlock(nn.Module):
         ln = lambda name: nn.LayerNorm(  # noqa: E731
             epsilon=self.ln_eps, dtype=self.dtype, name=name)
         x = ln("ln_attn")(x + attn(x))
-        h = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
-        # Exact (erf) GELU, matching BERT; the tanh approximation drifts
-        # ~1e-3 on imported weights.
-        h = nn.gelu(h, approximate=False)
-        h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
+        if self.moe_experts:
+            from tpuserve.ops.moe import SwitchFFN
+
+            # Recover the (B, S) 0/1 token mask from the additive key bias so
+            # padded tokens never claim expert capacity. The serving forward
+            # discards the load-balance aux (it only shapes training).
+            token_mask = (mask_bias[:, 0, 0, :] == 0.0).astype(jnp.float32)
+            h, _aux = SwitchFFN(self.moe_experts, self.d_ff,
+                                capacity_factor=self.moe_capacity_factor,
+                                dtype=self.dtype, name="moe")(x, token_mask)
+        else:
+            h = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
+            # Exact (erf) GELU, matching BERT; the tanh approximation drifts
+            # ~1e-3 on imported weights.
+            h = nn.gelu(h, approximate=False)
+            h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
         return ln("ln_mlp")(x + h)
 
 
@@ -118,6 +133,8 @@ class BertClassifier(nn.Module):
     attention_impl: str = "dense"
     ln_eps: float = 1e-12
     mesh: Any = None
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, ids, mask):
@@ -131,6 +148,8 @@ class BertClassifier(nn.Module):
             x = BertBlock(self.heads, self.d_ff, dtype=self.dtype,
                           attention_impl=self.attention_impl,
                           ln_eps=self.ln_eps, mesh=self.mesh,
+                          moe_experts=self.moe_experts,
+                          moe_capacity_factor=self.moe_capacity_factor,
                           name=f"layer{i}")(x, mask_bias)
         cls = x[:, 0, :]
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=self.dtype, name="pooler")(cls))
@@ -178,6 +197,20 @@ class BertServing(ServingModel):
                     f"ulysses attention deals heads over sp={cfg.sp}; "
                     f"local heads {local} (heads={heads}, tp={cfg.tp}) "
                     "are not divisible")
+        moe_experts = int(opt.get("moe_experts", 0))
+        if moe_experts and cfg.parallelism == "sharded" and cfg.tp > 1 \
+                and moe_experts % cfg.tp:
+            raise ValueError(
+                f"options.moe_experts={moe_experts} shards the expert dim "
+                f"over the model axis (tp={cfg.tp}); it must divide evenly")
+        if moe_experts and cfg.weights:
+            # import_tf_variables maps dense-FFN checkpoints (mlp_up/down);
+            # there is no TF source scheme for the MoE variant's
+            # moe/{router, w_up, w_down} params.
+            raise ValueError(
+                "options.moe_experts cannot be combined with weights=: no "
+                "TF import mapping exists for the MoE FFN; serve it with "
+                "seeded weights or an orbax checkpoint trained in-framework")
         self.dtype = jnp.dtype(cfg.dtype)
         self.max_seq = max(cfg.seq_buckets)
         vocab_file = opt.get("vocab_file")
@@ -199,6 +232,10 @@ class BertServing(ServingModel):
             # (tpuserve.ops.flash_attention); "ring"/"ulysses" =
             # sequence-parallel over the serving mesh (tpuserve.ops).
             attention_impl=attention,
+            # options.moe_experts=N serves a Switch-MoE FFN variant with the
+            # expert dim sharded on "model" (expert parallelism).
+            moe_experts=moe_experts,
+            moe_capacity_factor=float(opt.get("moe_capacity_factor", 1.25)),
         )
         self.top_k = min(5, cfg.num_classes)
 
@@ -404,6 +441,9 @@ class BertServing(ServingModel):
             (r"attn/out/kernel", P("model", None, None)),
             (r"mlp_up/kernel", P(None, "model")),
             (r"mlp_down/kernel", P("model", None)),
+            # EP: expert dim of the (E, D, F) MoE weights on "model" (same
+            # layout as train.TRAIN_PARTITION_RULES); router replicated.
+            (r"moe/w_(up|down)", P("model", None, None)),
             (r".*", P()),
         ]
 

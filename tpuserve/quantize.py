@@ -71,10 +71,17 @@ def quantize_leaf(w: np.ndarray) -> dict[str, np.ndarray]:
 
 
 def quantize_tree(params: Any, min_size: int = DEFAULT_MIN_SIZE) -> Any:
-    """Replace every eligible leaf with its quantized {"q8", "q8_scale"}."""
+    """Replace every eligible leaf with its quantized {"q8", "q8_scale"}.
+
+    Idempotent: already-quantized subtrees pass through untouched (otherwise
+    a large float scale leaf could itself be re-quantized, corrupting the
+    {"q8", "q8_scale"} structure — matters for pre-quantized checkpoints).
+    """
     return jax.tree_util.tree_map(
-        lambda x: quantize_leaf(np.asarray(x)) if eligible(x, min_size) else x,
+        lambda x: x if is_quantized(x)
+        else (quantize_leaf(np.asarray(x)) if eligible(x, min_size) else x),
         params,
+        is_leaf=is_quantized,
     )
 
 
