@@ -95,6 +95,9 @@ def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
                 max_inflight=4,
                 wire_size=wire,
                 wire_format=wire_format,
+                # BENCH_QUANTIZE=int8: weight-only quantized serving (halves
+                # the param upload; wire-bound throughput is unchanged).
+                quantize=os.environ.get("BENCH_QUANTIZE") or None,
                 session_mode="recycle" if mode == "recycle" else "direct",
                 relay_workers=int(env_f("BENCH_WORKERS", 3)),
                 relay_slots=int(env_f("BENCH_SLOTS", 6)),
@@ -190,8 +193,9 @@ def main() -> int:
         buckets = sorted({max(8, top // 2), top})
     concurrency = int(env_f("BENCH_CONCURRENCY", min(384, max(32, 3 * max(buckets)))))
 
+    quantize = os.environ.get("BENCH_QUANTIZE") or None
     print(f"# config: mode={mode} wire={wire_format}@{wire} buckets={buckets} "
-          f"concurrency={concurrency}", file=sys.stderr)
+          f"concurrency={concurrency} quantize={quantize}", file=sys.stderr)
 
     t0 = time.time()
     state, cfg = build_state(mode, wire_format, wire, buckets)
@@ -269,6 +273,7 @@ def main() -> int:
         "errors": closed["n_err"],
         "mode": mode,
         "wire": f"{wire_format}@{wire}",
+        "quantize": quantize,
         "link_mbps_measured": link_mbps,
         "wire_ceiling_img_s": round(ceiling, 1) if ceiling == ceiling else None,
         "pct_of_wire_ceiling": round(100 * value / ceiling, 1) if ceiling == ceiling else None,
