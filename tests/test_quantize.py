@@ -49,14 +49,14 @@ def test_zero_weight_channel_dequantizes_to_zero():
     assert (q[qz.QKEY] == 0).all() and (q[qz.SKEY] == 1.0).all()
 
 
-def test_quantize_specs_mirror_tp_sharding():
-    params = {
+def test_specs_for_tree_mirror_tp_sharding():
+    params = qz.quantize_tree({
         "up": np.zeros((256, 128), np.float32),    # TP on last axis
         "down": np.zeros((128, 256), np.float32),  # TP on first axis
         "bias": np.zeros((128,), np.float32),
-    }
-    specs = {"up": P(None, "model"), "down": P("model", None), "bias": P()}
-    out = qz.quantize_specs(params, specs, min_size=1024)
+    }, min_size=1024)
+    rules = [("up", P(None, "model")), ("down", P("model", None)), (".*", P())]
+    out = qz.specs_for_tree(rules, params)
     assert out["up"] == {qz.QKEY: P(None, "model"), qz.SKEY: P(None, "model")}
     # down's channel axis is the last (unsharded) one; its scale replicates.
     assert out["down"] == {qz.QKEY: P("model", None), qz.SKEY: P(None, None)}
@@ -163,3 +163,88 @@ def test_quantize_tree_is_idempotent():
     assert qz.is_quantized(twice["k"])
     np.testing.assert_array_equal(twice["k"][qz.QKEY], once["k"][qz.QKEY])
     np.testing.assert_array_equal(twice["k"][qz.SKEY], once["k"][qz.SKEY])
+
+
+def test_quantized_orbax_checkpoint_roundtrip(tmp_path):
+    """An int8 orbax checkpoint restores and serves; its outputs match
+    quantize-at-load serving exactly (same scheme, same weights)."""
+    from tpuserve import savedmodel
+
+    img = np.random.default_rng(7).integers(0, 255, (8, 8, 3), np.uint8)
+
+    # Reference: quantize-at-load serving from raw init weights.
+    model_ref = build(_toy_cfg(quantize="int8", quantize_min_size=1024))
+    rt_ref = build_runtime(model_ref)
+    bucket = model_ref.buckets()[0]
+    out_ref = rt_ref.fetch(rt_ref.run(bucket, model_ref.assemble([img], bucket)))
+
+    # Write the quantized checkpoint (what import-model --quantize emits).
+    raw = build(_toy_cfg()).load_params()
+    ckpt = tmp_path / "toy_q8"
+    savedmodel.save_orbax(str(ckpt),
+                          qz.quantize_tree(jax.device_get(raw), 1024))
+
+    model_q = build(_toy_cfg(weights=str(ckpt), quantize="int8",
+                             quantize_min_size=1024))
+    rt_q = build_runtime(model_q)
+    out_q = rt_q.fetch(rt_q.run(bucket, model_q.assemble([img], bucket)))
+    np.testing.assert_allclose(out_q["probs"], out_ref["probs"], rtol=1e-6)
+
+    leaves = jax.tree_util.tree_leaves(rt_q.params_per_mesh[0])
+    assert any(x.dtype == np.int8 for x in leaves)
+
+
+def test_quantized_checkpoint_without_flag_gives_guidance(tmp_path):
+    from tpuserve import savedmodel
+
+    raw = build(_toy_cfg()).load_params()
+    ckpt = tmp_path / "toy_q8"
+    savedmodel.save_orbax(str(ckpt),
+                          qz.quantize_tree(jax.device_get(raw), 1024))
+    model = build(_toy_cfg(weights=str(ckpt)))
+    with pytest.raises(ValueError, match='quantize = "int8"'):
+        model.load_params()
+
+
+def test_unquantized_checkpoint_serves_with_int8_flag(tmp_path):
+    """quantize="int8" over a raw checkpoint quantizes at load (the
+    documented fallback)."""
+    from tpuserve import savedmodel
+
+    raw = build(_toy_cfg()).load_params()
+    ckpt = tmp_path / "toy_raw"
+    savedmodel.save_orbax(str(ckpt), jax.device_get(raw))
+    model = build(_toy_cfg(weights=str(ckpt), quantize="int8",
+                           quantize_min_size=1024))
+    rt = build_runtime(model)
+    leaves = jax.tree_util.tree_leaves(rt.params_per_mesh[0])
+    assert any(x.dtype == np.int8 for x in leaves)
+
+
+def test_checkpoint_metadata_bridges_min_size_mismatch(tmp_path):
+    """A checkpoint quantized at min_size=1024 serves under the default
+    quantize_min_size: the restore target comes from checkpoint metadata,
+    not from the serving config's quantization settings."""
+    from tpuserve import savedmodel
+
+    raw = build(_toy_cfg()).load_params()
+    ckpt = tmp_path / "toy_q8"
+    savedmodel.save_orbax(str(ckpt),
+                          qz.quantize_tree(jax.device_get(raw), 1024))
+
+    model = build(_toy_cfg(weights=str(ckpt), quantize="int8"))  # default 4096
+    rt = build_runtime(model)
+    leaves = jax.tree_util.tree_leaves(rt.params_per_mesh[0])
+    assert any(x.dtype == np.int8 for x in leaves)
+
+
+def test_mismatched_checkpoint_gives_guidance(tmp_path):
+    """A checkpoint from a different model shape fails with guidance, not an
+    opaque downstream compile error."""
+    from tpuserve import savedmodel
+
+    raw = build(_toy_cfg(options={"hidden": 16})).load_params()
+    ckpt = tmp_path / "toy16"
+    savedmodel.save_orbax(str(ckpt), jax.device_get(raw))
+    with pytest.raises(ValueError, match="does not match"):
+        build(_toy_cfg(weights=str(ckpt))).load_params()  # hidden=32 default

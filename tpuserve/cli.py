@@ -52,6 +52,9 @@ def main(argv: list[str] | None = None) -> int:
     p_imp.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE",
                        help="model option for the import (TOML-parsed value), "
                             "e.g. --opt vocab_file=vocab.txt --opt layers=24")
+    p_imp.add_argument("--quantize", choices=["int8"], default=None,
+                       help="write a weight-only int8 checkpoint (half the "
+                            "bytes); serve it with quantize = \"int8\"")
 
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
@@ -90,7 +93,8 @@ def main(argv: list[str] | None = None) -> int:
                 parser.error(f"--opt must look like key=value, got {item!r}")
             key, _, text = item.partition("=")
             options[key.strip()] = _parse_toml_value(text.strip())
-        savedmodel.convert_cli(args.saved_model, args.family, args.out, options)
+        savedmodel.convert_cli(args.saved_model, args.family, args.out, options,
+                               quantize=args.quantize)
         return 0
 
     if args.cmd == "warmup":

@@ -13,7 +13,7 @@ its last axis (or the second-to-last when the last is size 1, e.g. depthwise
 conv kernels); ``scale = absmax(w, other_axes, keepdims) / 127`` and
 ``q = round(w / scale)``. Keeping the scale's singleton dims makes dequant a
 plain broadcast multiply and lets tensor-parallel PartitionSpecs transfer
-axis-by-axis (see ``quantize_specs``). Small (< min_size), integer, and 0/1-D
+axis-by-axis (see ``specs_for_tree``). Small (< min_size), integer, and 0/1-D
 leaves stay unquantized — biases, norms, and scalars are not worth the
 fidelity risk.
 
@@ -85,27 +85,38 @@ def quantize_tree(params: Any, min_size: int = DEFAULT_MIN_SIZE) -> Any:
     )
 
 
-def quantize_specs(params: Any, specs: Any,
-                   min_size: int = DEFAULT_MIN_SIZE) -> Any:
-    """Mirror ``quantize_tree`` on a PartitionSpec tree.
+def has_quantized_leaves(tree: Any) -> bool:
+    return any(is_quantized(leaf) for leaf in
+               jax.tree_util.tree_leaves(tree, is_leaf=is_quantized))
 
-    The int8 values keep the weight's spec (same shape). The keepdims scale
-    keeps the spec entry of its channel axis and replicates every reduced
-    (now size-1) axis, so a tensor-parallel weight's scale shards with it.
+
+def specs_for_tree(rules: list[tuple[str, Any]], tree: Any) -> Any:
+    """``match_partition_rules`` over a possibly-quantized tree.
+
+    Quantized subtrees are treated as one leaf at their weight's path (so
+    rule regexes see the original name, with no ``/q8`` suffix): the int8
+    values take the matched spec, the scale the spec entry of its channel
+    axis. Because decisions follow the tree's actual quantization state,
+    this needs no min_size agreement with whoever quantized it.
     """
+    from tpuserve.parallel.partition import _join_path, spec_for_name
 
-    def one(leaf: Any, spec: P) -> Any:
-        if not eligible(leaf, min_size):
-            return spec
-        ndim = len(leaf.shape)
-        axis = _channel_axis(leaf.shape)
-        full = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
-        scale_spec = P(*[full[i] if i == axis else None for i in range(ndim)])
-        return {QKEY: spec, SKEY: scale_spec}
-
-    # tree_map flattens `specs` only down to `params`' leaf positions
-    # (flatten_up_to), so each P arrives intact even though P is a tuple.
-    return jax.tree_util.tree_map(one, params, specs)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        tree, is_leaf=is_quantized)
+    out = []
+    for path, leaf in flat:
+        name = _join_path(path, "/")
+        if is_quantized(leaf):
+            w = leaf[QKEY]
+            spec = spec_for_name(rules, name, w.shape)
+            axis = _channel_axis(w.shape)
+            full = tuple(spec) + (None,) * (w.ndim - len(tuple(spec)))
+            out.append({QKEY: spec,
+                        SKEY: P(*[full[i] if i == axis else None
+                                  for i in range(w.ndim)])})
+        else:
+            out.append(spec_for_name(rules, name, getattr(leaf, "shape", ())))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def dequantize_tree(params: Any, dtype: Any) -> Any:

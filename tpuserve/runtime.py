@@ -131,21 +131,36 @@ class ModelRuntime:
             params = self.model.load_params()
         params = jax.device_get(params)
         dtype = jnp.dtype(self.cfg.dtype)
+        # Pre-quantized {"q8", "q8_scale"} subtrees stay as saved: scales are
+        # deliberately float32 (dequant casts into the compute dtype itself).
+        from tpuserve import quantize as qz
+
         return jax.tree_util.tree_map(
-            lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            lambda x: x if qz.is_quantized(x)
+            else (x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x),
             params,
+            is_leaf=qz.is_quantized,
         )
 
     def _shard_onto_meshes(self, params: Any) -> list:
-        rules = self.model.partition_rules()
-        specs = match_partition_rules(rules, params)
-        if self.cfg.quantize == "int8":
-            # Specs are derived from the raw tree (rule regexes see the
-            # original leaf paths), then mirrored onto the quantized one.
-            from tpuserve import quantize as qz
+        from tpuserve import quantize as qz
 
-            specs = qz.quantize_specs(params, specs, self.cfg.quantize_min_size)
+        rules = self.model.partition_rules()
+        pre_quantized = qz.has_quantized_leaves(params)
+        if pre_quantized and self.cfg.quantize != "int8":
+            raise ValueError(
+                f"{self.model.name}: loaded weights are int8-quantized but "
+                "quantize is not set; set quantize = \"int8\"")
+        if self.cfg.quantize == "int8":
+            # Quantize first (idempotent over pre-quantized checkpoints),
+            # then derive specs from the tree's actual quantization state —
+            # rule regexes see the original weight paths, scale specs derive
+            # from their weight's, and no save-time min_size agreement is
+            # needed for sharding.
             params = qz.quantize_tree(params, self.cfg.quantize_min_size)
+            specs = qz.specs_for_tree(rules, params)
+        else:
+            specs = match_partition_rules(rules, params)
         out = []
         for mesh in self.meshes:
             shardings = specs_to_shardings(specs, mesh)

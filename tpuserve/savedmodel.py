@@ -72,16 +72,50 @@ def save_orbax(path: str, params: Any) -> None:
 
 
 def load_orbax(path: str, model) -> Any:
-    """Restore with the model's own param structure as the abstract target."""
+    """Restore an orbax checkpoint, raw or int8-quantized.
+
+    The restore target comes from the checkpoint's own metadata (shapes +
+    dtypes of the saved tree), so a checkpoint written by ``import-model
+    --quantize int8`` — whose eligible leaves are {"q8", "q8_scale"}
+    sub-trees — restores exactly as saved with no agreement needed on
+    quantization settings. After restore, the tree is validated against the
+    model's structure (quantized sub-trees collapse to their weight's
+    shape) and a quantized checkpoint without quantize = "int8" set
+    produces guidance, not a downstream crash.
+    """
     import orbax.checkpoint as ocp
 
-    target = jax.eval_shape(model.init_params, jax.random.key(0))
-    # Restore as host numpy; the runtime device_puts with shardings itself.
-    target = jax.tree_util.tree_map(
-        lambda s: ocp.utils.to_shape_dtype_struct(s) if hasattr(ocp, "utils") else s, target
-    )
+    from tpuserve import quantize as qz
+
+    apath = os.path.abspath(path)
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(os.path.abspath(path), target)
+        saved = ckptr.metadata(apath).item_metadata
+        target = jax.tree_util.tree_map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), np.dtype(str(m.dtype))),
+            saved)
+        # Restore as host numpy; the runtime device_puts with shardings.
+        restored = ckptr.restore(apath, target)
+
+    if qz.has_quantized_leaves(restored) \
+            and getattr(model.cfg, "quantize", None) != "int8":
+        raise ValueError(
+            f"checkpoint at {path!r} holds int8-quantized weights; set "
+            "quantize = \"int8\" on the model to serve it")
+
+    raw = jax.eval_shape(model.init_params, jax.random.key(0))
+    shape_of = lambda x: (tuple(x[qz.QKEY].shape) if qz.is_quantized(x)  # noqa: E731
+                          else tuple(x.shape))
+    got, got_def = jax.tree_util.tree_flatten_with_path(
+        restored, is_leaf=qz.is_quantized)
+    want, want_def = jax.tree_util.tree_flatten_with_path(raw)
+    if len(got) != len(want) or any(
+            gp != wp or shape_of(g) != tuple(w.shape)
+            for (gp, g), (wp, w) in zip(got, want)):
+        raise ValueError(
+            f"checkpoint at {path!r} does not match {model.name}'s param "
+            "structure; pair the checkpoint with the family/options it was "
+            "converted with")
+    return restored
 
 
 # -- TF weight extraction (lazy TF import) -----------------------------------
@@ -154,13 +188,18 @@ def extract_graphdef_constants(path: str) -> dict[str, np.ndarray]:
 # -- CLI ---------------------------------------------------------------------
 
 def convert_cli(saved_model_path: str, family: str, out_path: str,
-                options: dict | None = None) -> None:
+                options: dict | None = None, quantize: str | None = None) -> None:
     """SavedModel/GraphDef -> orbax, so serving startup never needs TF.
 
     ``options`` configures the family for the import — keys naming
     ModelConfig fields (e.g. num_classes, dtype, seq_buckets) set those
     fields; everything else lands in ModelConfig.options (e.g. BERT's
-    vocab_file / layer sizes). The import must match the artifact."""
+    vocab_file / layer sizes). The import must match the artifact.
+
+    ``quantize="int8"`` writes the weight-only-quantized tree (half the
+    checkpoint bytes and startup upload); serve it with quantize = "int8".
+    The loader reads the saved structure from checkpoint metadata, so no
+    other settings need to agree."""
     import dataclasses
 
     from tpuserve.config import ModelConfig
@@ -176,8 +215,15 @@ def convert_cli(saved_model_path: str, family: str, out_path: str,
     fields = {k: opts.pop(k) for k in list(opts) if k in settable}
     cfg = ModelConfig(name=family, family=family, weights=saved_model_path,
                       options=opts, **fields)
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown --quantize mode {quantize!r}")
     model = modelzoo.build(cfg)
     params = load_params_for(model)
+    if quantize == "int8":
+        from tpuserve import quantize as qz
+
+        params = qz.quantize_tree(jax.device_get(params), cfg.quantize_min_size)
     save_orbax(out_path, params)
     log.info("wrote orbax checkpoint to %s", out_path)
-    print(f"converted {saved_model_path} -> {out_path}")
+    print(f"converted {saved_model_path} -> {out_path}"
+          + (f" ({quantize}-quantized)" if quantize else ""))
