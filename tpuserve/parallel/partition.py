@@ -47,19 +47,21 @@ def tree_map_with_name(fn: Callable[[str, Any], Any], tree: Any, sep: str = "/")
     return jax.tree_util.tree_unflatten(treedef, mapped)
 
 
+def spec_for_name(rules: list[tuple[str, P]], name: str, shape: tuple) -> P:
+    """First rule whose regex matches `name` wins; scalars/size-1 replicate."""
+    if len(shape) == 0 or int(np.prod(shape)) == 1:
+        return P()
+    for rule, spec in rules:
+        if re.search(rule, name) is not None:
+            return spec
+    raise ValueError(f"no partition rule matched param {name!r}")
+
+
 def match_partition_rules(rules: list[tuple[str, P]], params: Any) -> Any:
     """Return a pytree of PartitionSpec following ordered regex rules."""
-
-    def spec_for(name: str, leaf: Any) -> P:
-        shape = getattr(leaf, "shape", ())
-        if len(shape) == 0 or int(np.prod(shape)) == 1:
-            return P()
-        for rule, spec in rules:
-            if re.search(rule, name) is not None:
-                return spec
-        raise ValueError(f"no partition rule matched param {name!r}")
-
-    return tree_map_with_name(spec_for, params)
+    return tree_map_with_name(
+        lambda name, leaf: spec_for_name(rules, name, getattr(leaf, "shape", ())),
+        params)
 
 
 def specs_to_shardings(specs: Any, mesh: Mesh) -> Any:
