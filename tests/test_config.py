@@ -88,13 +88,14 @@ def test_import_model_cli_parses_opts(monkeypatch):
     captured = {}
     monkeypatch.setattr(
         savedmodel, "convert_cli",
-        lambda sm, fam, out, options=None: captured.update(
-            {"sm": sm, "fam": fam, "out": out, **(options or {})}))
+        lambda sm, fam, out, options=None, quantize=None: captured.update(
+            {"sm": sm, "fam": fam, "out": out, "quantize": quantize,
+             **(options or {})}))
     rc = cli.main(["import-model", "--saved-model", "x", "--family", "bert",
                    "--out", "y", "--opt", "layers=2",
                    "--opt", "vocab_file=v.txt"])
     assert rc == 0
-    assert captured == {"sm": "x", "fam": "bert", "out": "y",
+    assert captured == {"sm": "x", "fam": "bert", "out": "y", "quantize": None,
                         "layers": 2, "vocab_file": "v.txt"}
 
 
