@@ -104,3 +104,19 @@ def test_import_model_cli_rejects_reserved_opts():
 
     with pytest.raises(ValueError, match="weights"):
         savedmodel.convert_cli("sm", "toy", "out", {"weights": "/elsewhere"})
+
+
+def test_example_serve_all_toml_parses_and_builds():
+    """The shipped example config parses, covers all five families, and
+    every model in it constructs (no compile — just the family builds)."""
+    import os
+
+    from tpuserve.models import build
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "serve_all.toml")
+    cfg = load_config(path)
+    assert {m.family for m in cfg.models} == {
+        "resnet50", "mobilenetv3", "bert", "efficientdet", "sd15"}
+    for m in cfg.models:
+        build(m)

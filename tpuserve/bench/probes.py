@@ -18,25 +18,34 @@ import textwrap
 H2D_PROBE_SRC = textwrap.dedent("""
     import time, json, numpy as np, jax, jax.numpy as jnp
     mode = %r
-    mb, iters = 16, 5
-    arr = np.random.default_rng(0).integers(0, 255, (mb << 20,), np.uint8)
+    CHUNK = 8 << 20  # every transfer is this shape: compiles warm once
+    chunk = np.random.default_rng(0).integers(0, 255, (CHUNK,), np.uint8)
 
-    # Untimed warm-up in EVERY mode: PJRT client init + first-transfer setup
-    # cost seconds on the tunnel and must not land inside one mode's window.
-    warm = jax.device_put(np.zeros((1024,), np.uint8))
+    # Untimed warm-up in EVERY mode: PJRT client init, first-transfer setup,
+    # and the dependent read's slice+sum compile (shape-specialized — warming
+    # it here keeps XLA compile time out of every measured window).
+    warm = jax.device_put(np.zeros((CHUNK,), np.uint8))
     jax.block_until_ready(warm)
+    int(jnp.sum(warm[:8].astype(jnp.int32)))
 
-    def h2d_rate():
+    def timed(k):
         t0 = time.perf_counter()
-        devs = [jax.device_put(arr) for _ in range(iters)]
+        devs = [jax.device_put(chunk) for _ in range(k)]
         jax.block_until_ready(devs)
         int(jnp.sum(devs[-1][:8].astype(jnp.int32)))  # dependent read: truth
-        return (mb << 20) * iters / (time.perf_counter() - t0) / 1e6  # MB/s
+        return time.perf_counter() - t0
 
+    # Sizing pass (one chunk), then ONE measurement of k chunks sized to
+    # ~6 s at the estimated rate. Bounds probe wall time on slow hours (a
+    # fixed 80 MiB probe took 40+ s at 2 MB/s) while fast links still
+    # measure a large transfer for accuracy.
+    t1 = timed(1)
+    k = max(1, min(9, round(CHUNK / max(t1, 1e-3) * 6.0 / CHUNK)))
     if mode == "after_d2h":
-        d = jax.device_put(arr)
-        np.asarray(d)          # one full D2H readback first
-    print(json.dumps({"mbps": h2d_rate()}))
+        np.asarray(warm)       # one full-chunk D2H right before the window
+    t2 = timed(k)
+    print(json.dumps({"mbps": k * CHUNK / t2 / 1e6,
+                      "probe_bytes": (k + 1) * CHUNK}))
 """)
 
 
@@ -44,7 +53,7 @@ def measure_h2d_mbps(mode: str = "virgin", timeout: float = 600.0,
                      cwd: str | None = None) -> dict:
     """Run the H2D probe in a fresh subprocess; mode 'virgin' | 'after_d2h'.
 
-    Returns {"mbps": float} or {"error": str}.
+    Returns {"mbps": float, "probe_bytes": int} or {"error": str}.
     """
     proc = subprocess.run(
         [sys.executable, "-c", H2D_PROBE_SRC % mode],
