@@ -307,3 +307,38 @@ def test_class_labels_in_responses(tmp_path, loop):
             await client.close()
 
     loop.run_until_complete(go())
+
+
+def test_periodic_canary_degrades_and_recovers(loop):
+    """canary_interval_s > 0: /healthz reflects live failures (503) and
+    recovers when the model serves again."""
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single", request_timeout_ms=10_000.0)],
+        decode_threads=2, canary_interval_s=0.15,
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            assert (await client.get("/healthz")).status == 200
+            # Live failure: every batch dispatch now raises.
+            state.batchers["toy"].fault_hook = lambda: (_ for _ in ()).throw(
+                RuntimeError("injected"))
+            await asyncio.sleep(0.5)
+            r = await client.get("/healthz")
+            assert r.status == 503, await r.text()
+            assert (await r.json())["status"] == "degraded"
+            # Recovery.
+            state.batchers["toy"].fault_hook = None
+            await asyncio.sleep(0.5)
+            assert (await client.get("/healthz")).status == 200
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())

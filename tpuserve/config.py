@@ -155,6 +155,15 @@ class ServerConfig:
     compilation_cache_dir: str = ""
     # Validate-on-startup canary (tiny inference per model) on/off.
     startup_canary: bool = True
+    # > 0: re-run the per-model canary every this many seconds so /healthz
+    # reflects live serving health, not the startup snapshot. Canary
+    # inferences ride the normal serving path and appear in /metrics like
+    # any synthetic probe; a shed canary (queue full) keeps the last status.
+    canary_interval_s: float = 0.0
+    # Debug mode (SURVEY.md §5): raise on NaN/Inf produced by any jitted
+    # computation (sets jax_debug_nans + jax_debug_infs). Expensive —
+    # re-checks every output; dev only.
+    debug_nans: bool = False
     # Run every compiled executable once at startup so first requests don't
     # pay PJRT program load (runtime.ModelRuntime.prewarm).
     prewarm_executables: bool = True

@@ -54,6 +54,9 @@ def configure_jax(cfg: ServerConfig) -> None:
     if cfg.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if cfg.debug_nans:
+        jax.config.update("jax_debug_nans", True)
+        jax.config.update("jax_debug_infs", True)  # NaN alone misses overflow
 
 
 @dataclass

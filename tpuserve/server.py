@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures as cf
+import contextlib
 import json
 import logging
 import time
@@ -62,6 +63,7 @@ class ServerState:
         self.runtimes: dict[str, ModelRuntime] = {}
         self.batchers: dict[str, ModelBatcher] = {}
         self.canary_ok: dict[str, bool] = {}
+        self._canary_task: asyncio.Task | None = None
 
     def build(self) -> None:
         configure_jax(self.cfg)
@@ -100,25 +102,50 @@ class ServerState:
             self.batchers[name] = b
         if self.cfg.startup_canary:
             await self.run_canaries()
+        if self.cfg.canary_interval_s > 0:
+            self._canary_task = asyncio.create_task(self._canary_loop())
 
-    async def run_canary(self, name: str) -> bool:
+    async def _canary_loop(self) -> None:
+        """Re-run the per-model canary on an interval so /healthz reflects
+        live serving health (degrades on failure, recovers on success).
+        Canary inferences ride the normal serving path, so they are visible
+        in /metrics like any synthetic probe; the per-cycle timeout is
+        bounded by the interval so one hung model can't stretch staleness
+        to the startup canary's 60 s budget."""
+        timeout = min(60.0, max(2.0, 2.0 * self.cfg.canary_interval_s))
+        while True:
+            await asyncio.sleep(self.cfg.canary_interval_s)
+            await self.run_canaries(timeout=timeout)
+
+    async def run_canary(self, name: str, timeout: float = 60.0) -> bool:
         """Tiny end-to-end inference for one model; feeds /healthz."""
         model = self.models[name]
         try:
             item = model.canary_item()
             fut = self.batchers[name].submit(item, group=model.group_key(item))
-            await asyncio.wait_for(fut, timeout=60.0)
+            await asyncio.wait_for(fut, timeout=timeout)
             self.canary_ok[name] = True
+        except QueueFull:
+            # A full queue is load shedding doing its job, not ill health;
+            # flipping /healthz to 503 here would pull the busiest instance
+            # from rotation and cascade the overload. Keep the last status.
+            log.info("canary for %s skipped: queue full (shedding)", name)
         except Exception:
             log.exception("canary failed for %s", name)
             self.canary_ok[name] = False
         return self.canary_ok[name]
 
-    async def run_canaries(self) -> None:
-        for name in self.models:
-            await self.run_canary(name)
+    async def run_canaries(self, timeout: float = 60.0) -> None:
+        # Concurrent: one hung model must not stall (or stale) the others.
+        await asyncio.gather(
+            *(self.run_canary(name, timeout=timeout) for name in self.models))
 
     async def stop(self) -> None:
+        if self._canary_task is not None:
+            self._canary_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._canary_task
+            self._canary_task = None
         # Deferred pools first retire their active workers (fast) so batcher
         # dispatch tasks awaiting epoch readback resolve in readback time,
         # not at the epoch deadline; then drain batchers, then stop pools.
