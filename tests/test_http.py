@@ -342,3 +342,33 @@ def test_periodic_canary_degrades_and_recovers(loop):
             await client.close()
 
     loop.run_until_complete(go())
+
+
+def test_canary_shed_without_prior_status(loop):
+    """A shed canary with no prior status (startup_canary=False) reports
+    healthy instead of KeyError-ing (review regression)."""
+    from tpuserve.batcher import QueueFull
+
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single", request_timeout_ms=10_000.0)],
+        decode_threads=2, startup_canary=False,
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            def full_submit(*a, **kw):
+                raise QueueFull("full")
+            state.batchers["toy"].submit = full_submit
+            assert await state.run_canary("toy") is True
+            assert (await client.get("/healthz")).status == 200
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())

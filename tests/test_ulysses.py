@@ -98,3 +98,27 @@ def test_bad_spec_rejected(mesh, rng):
     q, k, v = _qkv(rng)
     with pytest.raises(ValueError, match="seq dim"):
         ulysses_attention(q, k, v, mesh, spec=P("seq", None, None, None))
+
+
+def test_long_context_serving_2048_ulysses():
+    """Symmetry with the ring test: a (batch, 2048) bucket served with
+    Ulysses head all-to-all over sp=4 through the production runtime."""
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+    from tpuserve.runtime import build_runtime
+
+    sp_mesh = make_mesh(MeshPlan(sp=4), devices=jax.devices()[:8])
+    cfg = ModelConfig(
+        name="bert-long-u", family="bert", parallelism="sharded", sp=4,
+        batch_buckets=[2], seq_buckets=[2048], dtype="float32", num_classes=4,
+        options={"layers": 1, "d_model": 32, "heads": 4, "d_ff": 64,
+                 "vocab_size": 512, "attention": "ulysses"},
+    )
+    model = build(cfg)
+    rt = build_runtime(model, mesh=sp_mesh)
+    (bucket,) = rt.executables
+    item = model.host_decode(b'{"text": "' + b"ulysses context " * 80 + b'"}',
+                             "application/json")
+    out = rt.fetch(rt.run(bucket, model.assemble([item, item], bucket)))
+    assert out["probs"].shape == (2, model.top_k)
+    assert np.isfinite(out["probs"]).all()

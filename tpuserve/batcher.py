@@ -216,6 +216,11 @@ class ModelBatcher:
                 t2 = time.perf_counter()
                 self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
 
+                # "compute" = dispatch-to-ready wall time. With pipelined
+                # dispatch that includes waiting behind the other in-flight
+                # batches' transfers, so on a transfer-bound link this phase
+                # absorbs the wire wait (BASELINE.md "Link physics"), not
+                # just MXU time.
                 np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
                 t3 = time.perf_counter()
                 self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)

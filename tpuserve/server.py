@@ -115,7 +115,12 @@ class ServerState:
         timeout = min(60.0, max(2.0, 2.0 * self.cfg.canary_interval_s))
         while True:
             await asyncio.sleep(self.cfg.canary_interval_s)
-            await self.run_canaries(timeout=timeout)
+            try:
+                await self.run_canaries(timeout=timeout)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # one bad cycle must not end re-canarying
+                log.exception("periodic canary cycle failed")
 
     async def run_canary(self, name: str, timeout: float = 60.0) -> bool:
         """Tiny end-to-end inference for one model; feeds /healthz."""
@@ -133,7 +138,9 @@ class ServerState:
         except Exception:
             log.exception("canary failed for %s", name)
             self.canary_ok[name] = False
-        return self.canary_ok[name]
+        # .get: a shed canary with no prior status (startup_canary=False)
+        # must not KeyError — treat never-measured as healthy.
+        return self.canary_ok.get(name, True)
 
     async def run_canaries(self, timeout: float = 60.0) -> None:
         # Concurrent: one hung model must not stall (or stale) the others.
