@@ -1,6 +1,8 @@
 """Metrics/histograms/tracer (C8)."""
 
 import json
+import logging
+import sys
 
 from tpuserve.obs import Histogram, Metrics, percentile
 
@@ -67,3 +69,22 @@ def test_prometheus_label_values_escaped():
     # Still exactly one sample line for the counter
     assert sum(1 for line in text.splitlines()
                if line.startswith("requests_total{")) == 1
+
+
+def test_json_log_formatter_emits_parseable_lines():
+    from tpuserve.server import JsonLogFormatter
+
+    fmt = JsonLogFormatter()
+    rec = logging.LogRecord("tpuserve.x", logging.INFO, __file__, 1,
+                            "served %d items", (42,), None)
+    out = json.loads(fmt.format(rec))
+    assert out["msg"] == "served 42 items"
+    assert out["level"] == "INFO" and out["logger"] == "tpuserve.x"
+
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError:
+        rec2 = logging.LogRecord("tpuserve.x", logging.ERROR, __file__, 1,
+                                 "failed", (), sys.exc_info())
+    out2 = json.loads(fmt.format(rec2))
+    assert "boom" in out2["exc"]

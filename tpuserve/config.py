@@ -169,6 +169,9 @@ class ServerConfig:
     prewarm_executables: bool = True
     # Observability: max request-trace events kept for /debug/trace.
     trace_capacity: int = 65536
+    # Emit one JSON object per log line (machine-ingestible) instead of the
+    # human-readable default.
+    log_json: bool = False
 
     def model(self, name: str) -> ModelConfig:
         for m in self.models:

@@ -349,9 +349,37 @@ def make_app(state: ServerState) -> web.Application:
     return app
 
 
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line: ts/level/logger/msg (+ exc when present)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        if record.stack_info:
+            out["stack"] = self.formatStack(record.stack_info)
+        return json.dumps(out, ensure_ascii=False)
+
+
+def configure_logging(cfg: ServerConfig) -> None:
+    if cfg.log_json:
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonLogFormatter())
+        logging.basicConfig(level=logging.INFO, handlers=[handler])
+    else:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+
 def serve(cfg: ServerConfig) -> None:
     """Blocking entry point: build models, compile, serve."""
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    configure_logging(cfg)
     # Multi-host: must happen before ServerState.build() touches a device —
     # backend init freezes the process's view of the topology.
     from tpuserve.parallel import init_distributed
