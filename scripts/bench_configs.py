@@ -113,6 +113,13 @@ def run_family(name: str) -> int:
     from tpuserve.server import ServerState, make_app
 
     fam = FAMILIES[name]
+    quantize = os.environ.get("BENCHC_QUANTIZE") or None
+    if quantize:
+        if quantize != "int8":
+            raise SystemExit(f"BENCHC_QUANTIZE must be 'int8', got {quantize!r}")
+        # Applies to every family this invocation runs — stated in the
+        # header and the result line so rows can't be mistaken for bf16.
+        fam["model"]["quantize"] = quantize
     port = int(os.environ.get("BENCH_PORT", 18441))
     cfg = ServerConfig(
         host="127.0.0.1", port=port, decode_inline=True, startup_canary=False,
@@ -123,7 +130,8 @@ def run_family(name: str) -> int:
     state = ServerState(cfg)
     state.build()
     build_s = round(time.time() - t0, 1)
-    print(f"# {name}: build+compile+prewarm {build_s}s", file=sys.stderr)
+    print(f"# {name}: build+compile+prewarm {build_s}s quantize={quantize}",
+          file=sys.stderr)
 
     async def run() -> dict:
         runner = web.AppRunner(make_app(state), access_log=None)
@@ -141,7 +149,7 @@ def run_family(name: str) -> int:
         v = s["latency"][key]
         print(f"#   {key}: n={v['n']} p50={v['p50_ms']:.1f} "
               f"p99={v['p99_ms']:.1f}", file=sys.stderr)
-    line = {"config": name, "build_s": build_s,
+    line = {"config": name, "build_s": build_s, "quantize": quantize,
             "wire": f"{fam['model'].get('wire_format', 'json')}"
                     f"@{fam['model'].get('wire_size', '-')}"
                     if fam["payload"] == "jpeg" else "json",
