@@ -120,3 +120,31 @@ def test_example_serve_all_toml_parses_and_builds():
         "resnet50", "mobilenetv3", "bert", "efficientdet", "sd15"}
     for m in cfg.models:
         build(m)
+
+
+def test_warmup_and_describe_cli(tmp_path, capsys):
+    """C10: `warmup` builds+compiles from a TOML config and prints the
+    runtime inventory; `describe` prints the device/mesh view."""
+    import json
+
+    from tpuserve import cli
+
+    toml = tmp_path / "w.toml"
+    toml.write_text(
+        'port = 18999\n'
+        '[[model]]\n'
+        'name = "toy"\n'
+        'family = "toy"\n'
+        'batch_buckets = [1, 2]\n'
+        'dtype = "float32"\n'
+        'num_classes = 10\n'
+        'parallelism = "single"\n'
+    )
+    assert cli.main(["warmup", "--config", str(toml)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["toy"]["buckets"] == [[1], [2]]
+    assert out["toy"]["quantize"] is None
+
+    assert cli.main(["describe"]) == 0
+    desc = json.loads(capsys.readouterr().out)
+    assert desc["platform"] == "cpu" and len(desc["devices"]) == 8
