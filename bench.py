@@ -169,6 +169,17 @@ def main() -> int:
     duration = env_f("BENCH_DURATION", 20)
     warmup = env_f("BENCH_WARMUP", 6)
 
+    # Fresh per-run chip-compute probe (VERDICT r3 weak 2: the old hardcoded
+    # 10_564 constant would silently misreport after any regression). Runs in
+    # its own subprocess BEFORE the server takes the chip. BENCH_CHIP_PROBE=0
+    # skips it (field becomes null, never stale).
+    chip = {}
+    if int(env_f("BENCH_CHIP_PROBE", 1)):
+        from tpuserve.bench.probes import measure_chip_img_s
+
+        chip = measure_chip_img_s(batch=int(env_f("BENCH_CHIP_BATCH", 256)))
+        print(f"# chip probe: {chip}", file=sys.stderr)
+
     link_mbps = measure_link_rate_mbps()
     bpp = 1.5 if wire_format == "yuv420" else 3.0
     img_bytes = int(wire * wire * bpp)
@@ -232,17 +243,20 @@ def main() -> int:
         site = web.TCPSite(runner, cfg.host, cfg.port)
         await site.start()
         try:
-            # Best-of-two closed-loop passes: the tunnel's rate drifts on
-            # minute scales, so a single 20 s window under- or over-draws it;
-            # both passes go to stderr, the better one is the headline.
+            # Median-of-3 closed-loop passes: the tunnel's rate drifts on
+            # minute scales, so a single 20 s window under- or over-draws it.
+            # The headline is the MEDIAN pass (max-of-N was upward-biased —
+            # VERDICT r3 weak 3 / ADVICE r3); every pass goes to stderr and
+            # the full list + spread ship in the JSON.
             passes = []
-            for i in range(max(1, int(env_f("BENCH_CLOSED_PASSES", 2)))):
+            for i in range(max(1, int(env_f("BENCH_CLOSED_PASSES", 3)))):
                 res = await run_load(
                     cfg, payload, ctype, duration, warmup if i == 0 else 2,
                     concurrency, None, client_batch=client_batch)
                 print(f"# closed-loop pass {i + 1}: {res}", file=sys.stderr)
                 passes.append(res)
-            closed = max(passes, key=lambda r: r["throughput_per_s"])
+            by_tp = sorted(passes, key=lambda r: r["throughput_per_s"])
+            closed = by_tp[len(by_tp) // 2] if len(by_tp) % 2 else by_tp[len(by_tp) // 2 - 1]
             open_res = None
             # Open-loop rate is REQUESTS/s; closed throughput counts items.
             rate = env_f("BENCH_OPEN_RATE", 0.0) or round(
@@ -282,10 +296,15 @@ def main() -> int:
         "wire": f"{wire_format}@{wire}",
         "quantize": quantize,
         "closed_passes": [p["throughput_per_s"] for p in passes],
+        "closed_spread_per_s": round(
+            max(p["throughput_per_s"] for p in passes)
+            - min(p["throughput_per_s"] for p in passes), 1),
         "link_mbps_measured": link_mbps,
         "wire_ceiling_img_s": round(ceiling, 1) if ceiling == ceiling else None,
         "pct_of_wire_ceiling": round(100 * value / ceiling, 1) if ceiling == ceiling else None,
-        "chip_compute_img_s": 10_564,  # measured, BASELINE.md "Link physics"
+        # Measured fresh THIS run (subprocess probe; null if skipped/failed).
+        "chip_compute_img_s": chip.get("img_s"),
+        "chip_ms_per_batch": chip.get("ms_per_batch"),
     }
     if open_res:
         line["open_loop"] = {

@@ -9,9 +9,10 @@ is process-stateful):
 2. h2d_after_d2h_mbps: the same measurement after one device->host readback
    (r2 claimed a permanent post-D2H slowdown; the r3 re-measurement with fair
    warm-up did not reproduce it — both probes stay to keep checking).
-3. chip_resnet50: device-resident ResNet-50 bf16 inference rate (batch 256,
-   inputs already on device) — the compute ceiling with zero wire
-   involvement.
+3. chip_resnet50: device-resident ResNet-50 bf16 inference rate at several
+   batch sizes (inputs already on device) — the compute ceiling with zero
+   wire involvement, and the raw ms/batch curve behind BASELINE.md's
+   latency-budget table.
 
 The H2D probes come from ``tpuserve.bench.probes`` — the same source bench.py
 uses for its wire-ceiling math, so the two can never disagree.
@@ -21,61 +22,11 @@ Prints one JSON line; paste into BASELINE.md.
 
 import json
 import os
-import subprocess
 import sys
-import textwrap
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tpuserve.bench.probes import measure_h2d_mbps  # noqa: E402
-
-CHIP_PROBE = textwrap.dedent("""
-    import time, json, numpy as np, jax, jax.numpy as jnp
-    import sys
-    sys.path.insert(0, %r)
-    from tpuserve.config import ModelConfig
-    from tpuserve.models import build
-    cfg = ModelConfig(name="r", family="resnet50", dtype="bfloat16",
-                      batch_buckets=[256])
-    m = build(cfg)
-    params = m.init_params(jax.random.key(0))
-    # Timing caveats on the tunneled dev TPU: block_until_ready returns
-    # before remote execution finishes, and a dependent per-batch scalar
-    # read adds ~190 ms of relay RTT. The honest method is a
-    # device-resident fori_loop of N forwards with a forced dependency
-    # chain between iterations (defeats loop-invariant hoisting), one
-    # scalar read at the end.
-    N = 32
-
-    @jax.jit
-    def many(params, x):
-        def body(i, carry):
-            x, acc = carry
-            out = m.forward(params, x)
-            s = out["probs"][0, 0].astype(jnp.float32)
-            x = x + (s * 0).astype(x.dtype)
-            return (x, acc + s)
-        _, acc = jax.lax.fori_loop(0, N, body, (x, jnp.float32(0)))
-        return acc
-
-    x = jax.device_put(np.random.default_rng(0).integers(
-        0, 255, (256, 256, 256, 3), np.uint8))
-    float(many(params, x))  # compile + warm
-    t0 = time.perf_counter()
-    float(many(params, x))
-    dur = time.perf_counter() - t0
-    print(json.dumps({"img_s": round(256 * N / dur, 1),
-                      "ms_per_batch": round(dur / N * 1e3, 2)}))
-""")
-
-
-def run_chip() -> dict:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    p = subprocess.run([sys.executable, "-c", CHIP_PROBE % repo],
-                       capture_output=True, text=True, timeout=900)
-    if p.returncode != 0:
-        return {"error": p.stderr.strip()[-300:]}
-    return json.loads(p.stdout.strip().splitlines()[-1])
+from tpuserve.bench.probes import measure_chip_img_s, measure_h2d_mbps  # noqa: E402
 
 
 def main() -> int:
@@ -84,9 +35,16 @@ def main() -> int:
                       ("h2d_after_d2h_mbps", "after_d2h")):
         r = measure_h2d_mbps(mode, timeout=900)
         out[key] = round(r["mbps"], 1) if "mbps" in r else r  # keep error dicts
-    out["chip_resnet50"] = run_chip()
+    # Several batch sizes: feeds the BASELINE.md latency-budget table
+    # (ms/batch vs batch is the raw input to the p50<=15ms operating-point
+    # derivation) as well as the headline chip ceiling at 256.
+    out["chip_resnet50"] = {
+        str(b): measure_chip_img_s(batch=b) for b in (16, 32, 64, 128, 256)
+    }
     print(json.dumps(out))
-    return int(any(isinstance(v, dict) and "error" in v for v in out.values()))
+    bad = any(isinstance(v, dict) and "error" in v for v in out.values())
+    bad = bad or any("error" in r for r in out["chip_resnet50"].values())
+    return int(bad)
 
 
 if __name__ == "__main__":

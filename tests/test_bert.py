@@ -103,6 +103,7 @@ def served():
     return model, rt
 
 
+@pytest.mark.slow
 def test_buckets_cross_product(served):
     model, rt = served
     assert model.buckets() == [(1, 8), (1, 16), (2, 8), (2, 16)]
@@ -155,6 +156,7 @@ def test_bad_json_raises(served):
 
 # -- sequence-parallel serving -----------------------------------------------
 
+@pytest.mark.slow
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 def test_sequence_parallel_serving_matches_dense(impl):
     """attention=ring|ulysses + sp=2 on the sharded 8-device mesh:
@@ -220,6 +222,7 @@ def test_nonpositive_sp_rejected_at_config():
 
 # -- HTTP end-to-end ----------------------------------------------------------
 
+@pytest.mark.slow
 def test_bert_http_end_to_end():
     from aiohttp.test_utils import TestClient, TestServer
 

@@ -17,6 +17,8 @@ from tpuserve.config import ModelConfig, load_config
 from tpuserve.deferred import DeferredPool
 from tpuserve.models import build
 
+pytestmark = pytest.mark.slow
+
 
 def make_cfg(**over) -> ModelConfig:
     base = dict(

@@ -109,6 +109,7 @@ def _cpu_subprocess_env(fake_devices: int | None = None) -> dict:
     return env
 
 
+@pytest.mark.slow
 def test_two_process_collectives_across_the_dcn_seam():
     """The real thing, minus the hardware: two OS processes (4 fake CPU
     devices each) form one 8-device jax.distributed cluster through
@@ -166,6 +167,7 @@ def test_two_process_collectives_across_the_dcn_seam():
             assert f"RANK{r} OK total=28.0 hosts=2" in out, (r, out[-2000:])
 
 
+@pytest.mark.slow
 def test_real_initialize_single_process_subprocess():
     """jax.distributed.initialize actually handshakes (1-process cluster).
 

@@ -15,6 +15,8 @@ from tpuserve.models import build
 from tpuserve.models.efficientdet import (
     decode_boxes, fixed_nms, make_anchors, pairwise_iou)
 
+pytestmark = pytest.mark.slow
+
 
 def det_cfg(**over) -> ModelConfig:
     base = dict(

@@ -72,6 +72,7 @@ def test_bf16_inputs(rng):
     np.testing.assert_allclose(np.asarray(raw).astype(np.float32), ref, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_bert_flash_option_matches_dense():
     """cfg.options['attention']='flash' serves identical logits (same params)."""
     from tpuserve.config import ModelConfig

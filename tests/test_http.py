@@ -206,6 +206,7 @@ def test_client_batch_over_limit_400(client):
     run(go())
 
 
+@pytest.mark.slow
 def test_two_models_one_server():
     """Two families behind one server: independent batchers/runtimes,
     per-model routing and metrics."""
@@ -342,6 +343,28 @@ def test_periodic_canary_degrades_and_recovers(loop):
             await client.close()
 
     loop.run_until_complete(go())
+
+
+def test_canary_timeout_floored_at_request_timeout():
+    """A small canary_interval_s must not shrink a slow model's canary
+    timeout below its own request_timeout_ms (ADVICE r3: sd15-class models
+    with ~1.6 s+ per-image device time flapped /healthz at 2 s)."""
+    cfg = ServerConfig(
+        models=[
+            ModelConfig(name="slow", family="toy", batch_buckets=[1],
+                        dtype="float32", num_classes=10, parallelism="single",
+                        request_timeout_ms=30_000.0),
+            ModelConfig(name="fast", family="toy", batch_buckets=[1],
+                        dtype="float32", num_classes=10, parallelism="single",
+                        request_timeout_ms=500.0),
+        ],
+        decode_threads=2, canary_interval_s=0.25,
+    )
+    state = ServerState(cfg)
+    state.build()
+    t = state.canary_timeouts()
+    assert t["slow"] == 30.0          # floored at its request timeout
+    assert t["fast"] == 2.0           # interval bound still applies
 
 
 def test_canary_shed_without_prior_status(loop):

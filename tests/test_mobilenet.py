@@ -11,6 +11,8 @@ import pytest
 from tpuserve.config import ModelConfig, ServerConfig
 from tpuserve.models import build
 
+pytestmark = pytest.mark.slow
+
 
 def mnv3_cfg(**over) -> ModelConfig:
     base = dict(

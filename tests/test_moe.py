@@ -13,6 +13,8 @@ import pytest
 
 from tpuserve.ops.moe import SwitchFFN, switch_route
 
+pytestmark = pytest.mark.slow
+
 
 def _reference(x, router, w_up, w_down):
     """Per-token loop: y[t] = gate[t] * FFN_{argmax expert}(x[t])."""

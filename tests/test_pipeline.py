@@ -27,6 +27,7 @@ def _mlp_params(rng, d):
             "b": jnp.asarray(rng.normal(size=(d,)).astype(np.float32) * 0.1)}
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("n_stages,n_micro", [(4, 8), (2, 3), (8, 1)])
 def test_matches_sequential(n_stages, n_micro):
     rng = np.random.default_rng(0)
@@ -57,6 +58,7 @@ def test_stage_params_actually_sharded():
         assert shard.data.shape == (1, d, d)  # one stage per device
 
 
+@pytest.mark.slow
 def test_transformer_block_stage():
     """The real train-step Block pipelines: stage = one pre-LN block."""
     from tpuserve.train import Block, TrainConfig
@@ -82,6 +84,7 @@ def test_transformer_block_stage():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_jit_compiles_one_program():
     """The whole schedule lowers under jit (one XLA program, scan inside)."""
     rng = np.random.default_rng(3)

@@ -127,6 +127,7 @@ def test_tp_sharded_quantized_bert_runs():
     assert np.isfinite(out["probs"]).all()
 
 
+@pytest.mark.slow
 def test_recycle_mode_with_int8_weights():
     """Regression: the deferred worker must compile the dequant-wrapped
     forward, not raw model.forward, when weights are stored int8."""
@@ -165,6 +166,7 @@ def test_quantize_tree_is_idempotent():
     np.testing.assert_array_equal(twice["k"][qz.SKEY], once["k"][qz.SKEY])
 
 
+@pytest.mark.slow
 def test_quantized_orbax_checkpoint_roundtrip(tmp_path):
     """An int8 orbax checkpoint restores and serves; its outputs match
     quantize-at-load serving exactly (same scheme, same weights)."""

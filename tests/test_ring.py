@@ -15,6 +15,8 @@ from tpuserve.ops import dense_attention, ring_attention
 from tpuserve.parallel import make_mesh
 from tpuserve.parallel.mesh import MeshPlan
 
+pytestmark = pytest.mark.slow
+
 
 def _qkv(rng, b=2, s=16, h=4, d=8):
     q = rng.normal(size=(b, s, h, d)).astype(np.float32)

@@ -29,6 +29,32 @@ def test_orbax_roundtrip(tmp_path, toy_model):
     )
 
 
+def test_load_orbax_rejects_wrong_dtype_class(tmp_path, toy_model):
+    """A checkpoint with an int leaf in a float weight slot must fail at
+    restore with guidance, not later via a cast surprise (ADVICE r3).
+    bf16-vs-f32 differences stay legal — only the dtype CLASS is checked."""
+    import jax
+
+    params = toy_model.init_params(jax.random.key(0))
+    bad = dict(params)
+    bad["w1"] = np.asarray(params["w1"]).astype(np.int32)
+    path = str(tmp_path / "ckpt_bad")
+    savedmodel.save_orbax(path, bad)
+    with pytest.raises(ValueError, match="dtype classes"):
+        savedmodel.load_orbax(path, toy_model)
+
+    # Same-shape float16 AND bfloat16 restore fine (class matches; numpy
+    # alone would call bf16 non-floating — jnp.issubdtype handles it)
+    import jax.numpy as jnp
+
+    for dt, tag in ((np.float16, "f16"), (jnp.bfloat16, "bf16")):
+        ok = dict(params)
+        ok["w1"] = np.asarray(params["w1"]).astype(dt)
+        path2 = str(tmp_path / f"ckpt_{tag}")
+        savedmodel.save_orbax(path2, ok)
+        savedmodel.load_orbax(path2, toy_model)
+
+
 def test_load_params_via_weights_config(tmp_path, toy_model):
     import jax
 
@@ -82,7 +108,17 @@ def test_graphdef_extraction(tmp_path):
 
 def test_unknown_format(tmp_path):
     with pytest.raises(ValueError):
-        savedmodel.detect_format(str(tmp_path / "nope.bin"))
+        savedmodel.detect_format(str(tmp_path / "nope.weights"))
+
+
+def test_torch_formats_detected(tmp_path):
+    for suffix in (".safetensors", ".ckpt", ".pt", ".pth", ".bin"):
+        assert savedmodel.detect_format(str(tmp_path / f"w{suffix}")) == "torch"
+
+
+def test_import_torch_variables_default_raises(toy_model):
+    with pytest.raises(NotImplementedError, match="torch"):
+        toy_model.import_torch_variables({"w": np.zeros(2)})
 
 
 def test_import_tf_variables_default_raises(toy_model):

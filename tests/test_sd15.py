@@ -13,6 +13,8 @@ from tpuserve.config import ModelConfig, ServerConfig
 from tpuserve.models import build
 from tpuserve.models.sd15 import MAX_TOKENS, ddim_schedule
 
+pytestmark = pytest.mark.slow
+
 TINY = dict(steps=3, guidance=5.0, vocab_size=512,
             text_layers=1, text_d_model=32, text_heads=2,
             unet_ch=16, unet_mults=[1, 2], unet_res=1, unet_attn_levels=[0],

@@ -24,6 +24,8 @@ import jax  # noqa: E402
 from tpuserve.config import ModelConfig  # noqa: E402
 from tpuserve.models import build  # noqa: E402
 
+pytestmark = pytest.mark.slow
+
 
 def _randomize(model: "tf.keras.Model", seed: int = 7, skip=None) -> None:
     """Give every variable a non-degenerate seeded value: zero biases or

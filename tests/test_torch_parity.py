@@ -1,0 +1,546 @@
+"""Randomized-weight torch-vs-JAX parity for the SD 1.5 import path
+(SURVEY.md §4-4 applied to C6's torch reader; VERDICT r3 next 2).
+
+Mirrors tests/test_tf_parity.py's method: build a REAL torch model in the
+published artifact's layout, randomize its weights, export its state_dict,
+import through ``tpuserve.models.sd15_import``, and assert the JAX forward
+reproduces the torch forward. The text tower runs against transformers'
+actual ``CLIPTextModel`` (fully independent implementation); the UNet and
+VAE run against minimal torch references written here that follow the
+LDM/CompVis module layout (same state_dict keys real checkpoints carry:
+``input_blocks.{k}.0.in_layers.0``, ``decoder.up.{i}.block.{j}``, ...).
+
+This is the test that catches every translation hazard in the mapper:
+conv/linear transposes, MHA head reshapes, the GEGLU half-swap, missing
+q/k/v biases, per-site GroupNorm epsilons, and the up/down block numbering.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpuserve.models import sd15_import  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+# Tiny-but-structurally-complete SD config shared by all parity tests.
+CH, MULTS, NRES, ATTN, HEADS = 8, (1, 2), 1, (0, 1), 2
+TXT_D, TXT_LAYERS, TXT_HEADS, VOCAB = 16, 2, 2, 99
+VAE_CH, VAE_MULTS = 8, (1, 2)
+
+TOL = dict(rtol=2e-3, atol=2e-4)
+
+
+def seed_all():
+    torch.manual_seed(0)
+    np.random.seed(0)
+
+
+def randomize(m: tnn.Module) -> tnn.Module:
+    """Non-degenerate random weights everywhere (incl. norm scales/biases)."""
+    with torch.no_grad():
+        for p in m.parameters():
+            p.copy_(torch.randn_like(p) * 0.2)
+    return m.eval()
+
+
+def sd_numpy(m: tnn.Module, prefix: str = "") -> dict:
+    return {prefix + k: v.numpy() for k, v in m.state_dict().items()}
+
+
+# -- torch reference modules (LDM layout) -------------------------------------
+
+def gn(ch: int, eps: float) -> tnn.GroupNorm:
+    return tnn.GroupNorm(math.gcd(32, ch), ch, eps=eps)
+
+
+class TRes(tnn.Module):
+    """LDM openaimodel.ResBlock: in_layers/emb_layers/out_layers naming."""
+
+    def __init__(self, in_ch, out_ch, temb_ch):
+        super().__init__()
+        self.in_layers = tnn.Sequential(
+            gn(in_ch, 1e-5), tnn.SiLU(), tnn.Conv2d(in_ch, out_ch, 3, padding=1))
+        self.emb_layers = tnn.Sequential(tnn.SiLU(), tnn.Linear(temb_ch, out_ch))
+        self.out_layers = tnn.Sequential(
+            gn(out_ch, 1e-5), tnn.SiLU(), tnn.Identity(),
+            tnn.Conv2d(out_ch, out_ch, 3, padding=1))
+        self.skip_connection = (tnn.Conv2d(in_ch, out_ch, 1)
+                                if in_ch != out_ch else tnn.Identity())
+
+    def forward(self, x, emb):
+        h = self.in_layers(x)
+        h = h + self.emb_layers(emb)[:, :, None, None]
+        return self.skip_connection(x) + self.out_layers(h)
+
+
+class TAttn(tnn.Module):
+    """LDM CrossAttention: to_q/to_k/to_v (no bias) + to_out.0."""
+
+    def __init__(self, d, ctx_d, heads):
+        super().__init__()
+        self.heads = heads
+        self.to_q = tnn.Linear(d, d, bias=False)
+        self.to_k = tnn.Linear(ctx_d, d, bias=False)
+        self.to_v = tnn.Linear(ctx_d, d, bias=False)
+        self.to_out = tnn.Sequential(tnn.Linear(d, d))
+
+    def forward(self, x, ctx=None):
+        ctx = x if ctx is None else ctx
+        b, n, d = x.shape
+        h, hd = self.heads, d // self.heads
+        q = self.to_q(x).view(b, n, h, hd).transpose(1, 2)
+        k = self.to_k(ctx).view(b, ctx.shape[1], h, hd).transpose(1, 2)
+        v = self.to_v(ctx).view(b, ctx.shape[1], h, hd).transpose(1, 2)
+        a = torch.softmax(q @ k.transpose(-1, -2) / math.sqrt(hd), dim=-1)
+        return self.to_out((a @ v).transpose(1, 2).reshape(b, n, d))
+
+
+class TGEGLU(tnn.Module):
+    def __init__(self, d, inner):
+        super().__init__()
+        self.proj = tnn.Linear(d, inner * 2)
+
+    def forward(self, x):
+        x, gate = self.proj(x).chunk(2, dim=-1)
+        return x * F.gelu(gate)
+
+
+class TFeedForward(tnn.Module):
+    def __init__(self, d):
+        super().__init__()
+        self.net = tnn.Sequential(TGEGLU(d, 4 * d), tnn.Identity(),
+                                  tnn.Linear(4 * d, d))
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class TBasic(tnn.Module):
+    def __init__(self, d, ctx_d, heads):
+        super().__init__()
+        self.norm1 = tnn.LayerNorm(d)
+        self.attn1 = TAttn(d, d, heads)
+        self.norm2 = tnn.LayerNorm(d)
+        self.attn2 = TAttn(d, ctx_d, heads)
+        self.norm3 = tnn.LayerNorm(d)
+        self.ff = TFeedForward(d)
+
+    def forward(self, x, ctx):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), ctx)
+        return x + self.ff(self.norm3(x))
+
+
+class TSpatial(tnn.Module):
+    def __init__(self, ch, ctx_d, heads):
+        super().__init__()
+        self.norm = gn(ch, 1e-6)
+        self.proj_in = tnn.Conv2d(ch, ch, 1)
+        self.transformer_blocks = tnn.ModuleList([TBasic(ch, ctx_d, heads)])
+        self.proj_out = tnn.Conv2d(ch, ch, 1)
+
+    def forward(self, x, ctx):
+        b, c, h, w = x.shape
+        x_in = x
+        x = self.proj_in(self.norm(x))
+        x = x.reshape(b, c, h * w).permute(0, 2, 1)
+        x = self.transformer_blocks[0](x, ctx)
+        x = x.permute(0, 2, 1).reshape(b, c, h, w)
+        return x_in + self.proj_out(x)
+
+
+class TDown(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.op = tnn.Conv2d(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class TUp(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = tnn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+def t_timestep_embedding(t, dim):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0) * torch.arange(half).float() / half)
+    args = t.float()[:, None] * freqs[None, :]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+class TUNet(tnn.Module):
+    """LDM UNetModel skeleton with identical state_dict numbering."""
+
+    def __init__(self, ch=CH, mults=MULTS, num_res=NRES, attn=ATTN,
+                 heads=HEADS, ctx_d=TXT_D):
+        super().__init__()
+        temb = 4 * ch
+        self.attn_levels = attn
+        self.time_embed = tnn.Sequential(
+            tnn.Linear(ch, temb), tnn.SiLU(), tnn.Linear(temb, temb))
+        self.input_blocks = tnn.ModuleList(
+            [tnn.ModuleList([tnn.Conv2d(4, ch, 3, padding=1)])])
+        cur = ch
+        for i, m in enumerate(mults):
+            for _ in range(num_res):
+                entry = [TRes(cur, ch * m, temb)]
+                cur = ch * m
+                if i in attn:
+                    entry.append(TSpatial(cur, ctx_d, heads))
+                self.input_blocks.append(tnn.ModuleList(entry))
+            if i != len(mults) - 1:
+                self.input_blocks.append(tnn.ModuleList([TDown(cur)]))
+        self.middle_block = tnn.ModuleList(
+            [TRes(cur, cur, temb), TSpatial(cur, ctx_d, heads),
+             TRes(cur, cur, temb)])
+        # Skip-channel bookkeeping replays the down path.
+        skips = [ch]
+        c2 = ch
+        for i, m in enumerate(mults):
+            for _ in range(num_res):
+                c2 = ch * m
+                skips.append(c2)
+            if i != len(mults) - 1:
+                skips.append(c2)
+        self.output_blocks = tnn.ModuleList()
+        for i, m in reversed(list(enumerate(mults))):
+            for j in range(num_res + 1):
+                entry = [TRes(cur + skips.pop(), ch * m, temb)]
+                cur = ch * m
+                if i in attn:
+                    entry.append(TSpatial(cur, ctx_d, heads))
+                if i != 0 and j == num_res:
+                    entry.append(TUp(cur))
+                self.output_blocks.append(tnn.ModuleList(entry))
+        self.out = tnn.Sequential(gn(cur, 1e-5), tnn.SiLU(),
+                                  tnn.Conv2d(cur, 4, 3, padding=1))
+        self.model_ch = ch
+
+    def _apply_entry(self, entry, h, emb, ctx):
+        for mod in entry:
+            if isinstance(mod, TRes):
+                h = mod(h, emb)
+            elif isinstance(mod, TSpatial):
+                h = mod(h, ctx)
+            else:
+                h = mod(h)
+        return h
+
+    def forward(self, x, t, ctx):
+        emb = self.time_embed(t_timestep_embedding(t, self.model_ch))
+        h = self.input_blocks[0][0](x)
+        hs = [h]
+        for entry in list(self.input_blocks)[1:]:
+            h = self._apply_entry(entry, h, emb, ctx)
+            hs.append(h)
+        h = self._apply_entry(self.middle_block, h, emb, ctx)
+        for entry in self.output_blocks:
+            h = torch.cat([h, hs.pop()], dim=1)
+            h = self._apply_entry(entry, h, emb, ctx)
+        return self.out(h)
+
+
+class TVAERes(tnn.Module):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm1 = gn(in_ch, 1e-6)
+        self.conv1 = tnn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = gn(out_ch, 1e-6)
+        self.conv2 = tnn.Conv2d(out_ch, out_ch, 3, padding=1)
+        if in_ch != out_ch:
+            self.nin_shortcut = tnn.Conv2d(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "nin_shortcut"):
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class TVAEAttn(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.norm = gn(ch, 1e-6)
+        self.q = tnn.Conv2d(ch, ch, 1)
+        self.k = tnn.Conv2d(ch, ch, 1)
+        self.v = tnn.Conv2d(ch, ch, 1)
+        self.proj_out = tnn.Conv2d(ch, ch, 1)
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        hn = self.norm(x)
+        q = self.q(hn).reshape(b, c, h * w).permute(0, 2, 1)
+        k = self.k(hn).reshape(b, c, h * w)
+        v = self.v(hn).reshape(b, c, h * w)
+        a = torch.softmax(torch.bmm(q, k) * (c ** -0.5), dim=2)
+        o = torch.bmm(v, a.permute(0, 2, 1)).reshape(b, c, h, w)
+        return x + self.proj_out(o)
+
+
+class TVAEMid(tnn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.block_1 = TVAERes(ch, ch)
+        self.attn_1 = TVAEAttn(ch)
+        self.block_2 = TVAERes(ch, ch)
+
+    def forward(self, x):
+        return self.block_2(self.attn_1(self.block_1(x)))
+
+
+class TVAEUpLevel(tnn.Module):
+    def __init__(self, in_ch, out_ch, upsample):
+        super().__init__()
+        self.block = tnn.ModuleList(
+            [TVAERes(in_ch if j == 0 else out_ch, out_ch) for j in range(3)])
+        if upsample:
+            self.upsample = TUp(out_ch)
+
+
+class TVAEDecoder(tnn.Module):
+    def __init__(self, ch=VAE_CH, mults=VAE_MULTS):
+        super().__init__()
+        top = ch * mults[-1]
+        self.conv_in = tnn.Conv2d(4, top, 3, padding=1)
+        self.mid = TVAEMid(top)
+        ups = {}
+        cur = top
+        for i, m in reversed(list(enumerate(mults))):
+            ups[i] = TVAEUpLevel(cur, ch * m, upsample=i != 0)
+            cur = ch * m
+        self.up = tnn.ModuleList([ups[i] for i in sorted(ups)])
+        self.norm_out = gn(cur, 1e-6)
+        self.conv_out = tnn.Conv2d(cur, 3, 3, padding=1)
+
+    def forward(self, z):
+        h = self.mid(self.conv_in(z))
+        for i in reversed(range(len(self.up))):
+            lvl = self.up[i]
+            for blk in lvl.block:
+                h = blk(h)
+            if hasattr(lvl, "upsample"):
+                h = lvl.upsample(h)
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class TVAE(tnn.Module):
+    """first_stage_model: post_quant_conv + decoder (serving subset)."""
+
+    def __init__(self):
+        super().__init__()
+        self.post_quant_conv = tnn.Conv2d(4, 4, 1)
+        self.decoder = TVAEDecoder()
+
+    def forward(self, z):
+        return self.decoder(self.post_quant_conv(z))
+
+
+# -- helpers -------------------------------------------------------------------
+
+def nchw(x_nhwc: np.ndarray) -> torch.Tensor:
+    return torch.from_numpy(x_nhwc).permute(0, 3, 1, 2).contiguous()
+
+
+def to_nhwc(t: torch.Tensor) -> np.ndarray:
+    return t.detach().permute(0, 2, 3, 1).numpy()
+
+
+def tiny_sd_options() -> dict:
+    return {
+        "steps": 2, "vocab_size": VOCAB,
+        "text_layers": TXT_LAYERS, "text_d_model": TXT_D, "text_heads": TXT_HEADS,
+        "unet_ch": CH, "unet_mults": list(MULTS), "unet_res": NRES,
+        "unet_attn_levels": list(ATTN), "unet_heads": HEADS,
+        "vae_ch": VAE_CH, "vae_mults": list(VAE_MULTS),
+    }
+
+
+def model_vocab_size() -> int:
+    """The served text tower's vocab is the tokenizer's (synthetic vocabs
+    add base characters on top of options.vocab_size), so the torch CLIP
+    reference must ask the model, not assume."""
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    probe = build(ModelConfig(name="sd", family="sd15", dtype="float32",
+                              batch_buckets=[1], image_size=32,
+                              options=tiny_sd_options()))
+    return probe.text_encoder.vocab_size
+
+
+# -- tests ---------------------------------------------------------------------
+
+def test_clip_text_parity_vs_transformers():
+    """Our CLIP tower vs transformers' torch CLIPTextModel — a fully
+    independent implementation of the exact module SD checkpoints embed."""
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    from tpuserve.models.sd15 import CLIPTextEncoder
+
+    seed_all()
+    tc = CLIPTextConfig(
+        vocab_size=VOCAB, hidden_size=TXT_D, intermediate_size=4 * TXT_D,
+        num_hidden_layers=TXT_LAYERS, num_attention_heads=TXT_HEADS,
+        max_position_embeddings=77, hidden_act="quick_gelu")
+    ref = randomize(CLIPTextModel(tc))
+    flat = sd_numpy(ref)
+
+    ids = np.random.randint(0, VOCAB, size=(2, 77)).astype(np.int32)
+    with torch.no_grad():
+        want = ref(input_ids=torch.from_numpy(ids.astype(np.int64))
+                   ).last_hidden_state.numpy()
+
+    params = sd15_import.map_clip_text(
+        flat, "text_model.", layers=TXT_LAYERS, heads=TXT_HEADS)
+    enc = CLIPTextEncoder(vocab_size=VOCAB, layers=TXT_LAYERS, d_model=TXT_D,
+                          heads=TXT_HEADS, dtype=jnp.float32)
+    got = np.asarray(enc.apply({"params": params}, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, **TOL)
+
+
+def test_unet_parity_vs_ldm_reference():
+    from tpuserve.models.sd15 import UNet
+
+    seed_all()
+    ref = randomize(TUNet())
+    flat = sd_numpy(ref)
+
+    x = np.random.randn(2, 8, 8, 4).astype(np.float32)
+    t = np.array([3, 750], dtype=np.int32)
+    ctx = np.random.randn(2, 77, TXT_D).astype(np.float32)
+    with torch.no_grad():
+        want = to_nhwc(ref(nchw(x), torch.from_numpy(t),
+                           torch.from_numpy(ctx)))
+
+    params = sd15_import.map_unet(
+        flat, "", model_ch=CH, mults=MULTS, num_res=NRES, attn_levels=ATTN,
+        heads=HEADS)
+    unet = UNet(model_ch=CH, mults=MULTS, num_res=NRES, attn_levels=ATTN,
+                heads=HEADS, dtype=jnp.float32)
+    got = np.asarray(unet.apply({"params": params}, jnp.asarray(x),
+                                jnp.asarray(t), jnp.asarray(ctx)))
+    np.testing.assert_allclose(got, want, **TOL)
+
+
+def test_vae_parity_vs_ldm_reference():
+    from tpuserve.models.sd15 import VAEDecoder
+
+    seed_all()
+    ref = randomize(TVAE())
+    flat = sd_numpy(ref)
+
+    z = np.random.randn(2, 8, 8, 4).astype(np.float32)
+    with torch.no_grad():
+        want = to_nhwc(ref(nchw(z)))
+
+    params = sd15_import.map_vae_decoder(flat, "", ch=VAE_CH, mults=VAE_MULTS)
+    vae = VAEDecoder(ch=VAE_CH, mults=VAE_MULTS, dtype=jnp.float32)
+    got = np.asarray(vae.apply({"params": params}, jnp.asarray(z)))
+    np.testing.assert_allclose(got, want, **TOL)
+
+
+def test_full_safetensors_checkpoint_end_to_end(tmp_path):
+    """Assemble a complete tiny LDM-layout checkpoint (all three towers,
+    real safetensors file), load through ModelConfig.weights ->
+    extract_torch_state_dict -> import_torch_variables, and serve a
+    forward — the path a user with v1-5-pruned.safetensors exercises."""
+    from safetensors.torch import save_file
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    seed_all()
+    tc = CLIPTextConfig(
+        vocab_size=model_vocab_size(), hidden_size=TXT_D,
+        intermediate_size=4 * TXT_D,
+        num_hidden_layers=TXT_LAYERS, num_attention_heads=TXT_HEADS,
+        max_position_embeddings=77, hidden_act="quick_gelu")
+    towers = {}
+    towers.update({f"cond_stage_model.transformer.{k}": v for k, v in
+                   randomize(CLIPTextModel(tc)).state_dict().items()})
+    towers.update({f"model.diffusion_model.{k}": v for k, v in
+                   randomize(TUNet()).state_dict().items()})
+    towers.update({f"first_stage_model.{k}": v for k, v in
+                   randomize(TVAE()).state_dict().items()})
+    path = str(tmp_path / "tiny_sd.safetensors")
+    save_file({k: v.contiguous() for k, v in towers.items()}, path)
+
+    cfg = ModelConfig(name="sd", family="sd15", dtype="float32",
+                      batch_buckets=[1], image_size=32, weights=path,
+                      options=tiny_sd_options())
+    model = build(cfg)
+    params = model.load_params()
+
+    # Same leaf count/shapes as a fresh init (validated inside the import);
+    # a forward through the whole DDIM loop executes and emits a PNG-able
+    # uint8 image.
+    item = model.host_decode(b'{"prompt": "a tpu", "seed": 7}',
+                             "application/json")
+    batch = model.assemble([item], (1,))
+    out = jax.jit(model.forward)(params, batch)
+    img = np.asarray(out["image"])
+    assert img.shape == (1, 32, 32, 3) and img.dtype == np.uint8
+
+
+def test_wrong_architecture_fails_with_guidance(tmp_path):
+    """A checkpoint whose UNet width disagrees with the config must fail at
+    import with an actionable message, not at compile."""
+    from safetensors.torch import save_file
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    seed_all()
+    tc = CLIPTextConfig(
+        vocab_size=model_vocab_size(), hidden_size=TXT_D,
+        intermediate_size=4 * TXT_D,
+        num_hidden_layers=TXT_LAYERS, num_attention_heads=TXT_HEADS,
+        max_position_embeddings=77, hidden_act="quick_gelu")
+    towers = {}
+    towers.update({f"cond_stage_model.transformer.{k}": v for k, v in
+                   randomize(CLIPTextModel(tc)).state_dict().items()})
+    towers.update({f"model.diffusion_model.{k}": v for k, v in
+                   randomize(TUNet(ch=16)).state_dict().items()})  # wrong width
+    towers.update({f"first_stage_model.{k}": v for k, v in
+                   randomize(TVAE()).state_dict().items()})
+    path = str(tmp_path / "wrong.safetensors")
+    save_file({k: v.contiguous() for k, v in towers.items()}, path)
+
+    cfg = ModelConfig(name="sd", family="sd15", dtype="float32",
+                      batch_buckets=[1], image_size=32, weights=path,
+                      options=tiny_sd_options())
+    with pytest.raises(ValueError, match="shape|architecture"):
+        build(cfg).load_params()
+
+
+def test_non_ldm_checkpoint_rejected(tmp_path):
+    from safetensors.torch import save_file
+
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+
+    path = str(tmp_path / "other.safetensors")
+    save_file({"some.random.weight": torch.zeros(3, 3)}, path)
+    cfg = ModelConfig(name="sd", family="sd15", dtype="float32",
+                      batch_buckets=[1], image_size=32, weights=path,
+                      options=tiny_sd_options())
+    with pytest.raises(ValueError, match="LDM"):
+        build(cfg).load_params()

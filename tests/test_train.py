@@ -4,6 +4,8 @@ import jax
 import numpy as np
 
 from tpuserve.parallel import make_mesh
+import pytest
+
 from tpuserve.train import (
     TrainConfig,
     dryrun,
@@ -14,6 +16,8 @@ from tpuserve.train import (
     save_train_state,
     synthetic_batch,
 )
+
+pytestmark = pytest.mark.slow
 
 
 def test_mesh_plan_factors():

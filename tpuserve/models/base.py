@@ -77,6 +77,16 @@ class ServingModel(abc.ABC):
             "weights to an orbax checkpoint or implement import_tf_variables"
         )
 
+    def import_torch_variables(self, flat: dict[str, np.ndarray]) -> Any:
+        """Translate a flat torch {name: array} state_dict into this model's
+        pytree. Family-specific; implement for families whose published
+        artifacts ship as torch/safetensors (e.g. SD 1.5)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no torch state_dict mapping; convert "
+            "the weights to an orbax checkpoint or implement "
+            "import_torch_variables"
+        )
+
     # -- shapes -------------------------------------------------------------
     @abc.abstractmethod
     def input_signature(self, bucket: tuple) -> Any:

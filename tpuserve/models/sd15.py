@@ -53,10 +53,20 @@ from tpuserve.text import CLIPBPETokenizer, WordPieceTokenizer, synthetic_vocab
 MAX_TOKENS = 77  # CLIP text context length; SD conditions on all 77 states.
 
 
-def _gn(ch: int, name: str) -> nn.GroupNorm:
-    """GroupNorm(32) with a group count that divides tiny test channels."""
-    return nn.GroupNorm(num_groups=math.gcd(32, ch), epsilon=1e-5,
+def _gn(ch: int, name: str, eps: float = 1e-6) -> nn.GroupNorm:
+    """GroupNorm(32) with a group count that divides tiny test channels.
+
+    Epsilons follow the published SD modules exactly (torch-import parity):
+    1e-5 in UNet ResBlocks and the UNet output norm, 1e-6 in spatial
+    transformers and everywhere in the VAE."""
+    return nn.GroupNorm(num_groups=math.gcd(32, ch), epsilon=eps,
                         dtype=jnp.float32, name=name)
+
+
+def _ln(name: str) -> nn.LayerNorm:
+    """LayerNorm with torch's default eps 1e-5 (CLIP/transformer blocks use
+    torch nn.LayerNorm; flax's 1e-6 default would drift imported weights)."""
+    return nn.LayerNorm(epsilon=1e-5, dtype=jnp.float32, name=name)
 
 
 def quick_gelu(x):
@@ -72,12 +82,12 @@ class CLIPBlock(nn.Module):
     @nn.compact
     def __call__(self, x, causal_mask):
         d = x.shape[-1]
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        h = _ln("ln1")(x).astype(self.dtype)
         h = nn.MultiHeadDotProductAttention(
             num_heads=self.heads, dtype=self.dtype, deterministic=True,
             name="attn")(h, h, h, mask=causal_mask)
         x = x + h
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        h = _ln("ln2")(x).astype(self.dtype)
         h = nn.Dense(4 * d, dtype=self.dtype, name="mlp_up")(h)
         h = quick_gelu(h)
         return x + nn.Dense(d, dtype=self.dtype, name="mlp_down")(h)
@@ -103,7 +113,7 @@ class CLIPTextEncoder(nn.Module):
         mask = nn.make_causal_mask(ids)
         for i in range(self.layers):
             x = CLIPBlock(self.heads, dtype=self.dtype, name=f"layer{i}")(x, mask)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x).astype(self.dtype)
+        return _ln("ln_final")(x).astype(self.dtype)
 
 
 # -- UNet ----------------------------------------------------------------------
@@ -122,13 +132,13 @@ class ResBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, temb):  # x (B,H,W,C), temb (B,T)
-        h = nn.swish(_gn(x.shape[-1], "norm1")(x)).astype(self.dtype)
+        h = nn.swish(_gn(x.shape[-1], "norm1", eps=1e-5)(x)).astype(self.dtype)
         h = nn.Conv(self.out_ch, (3, 3), padding="SAME", dtype=self.dtype,
                     name="conv1")(h)
         t = nn.Dense(self.out_ch, dtype=self.dtype, name="temb_proj")(
             nn.swish(temb).astype(self.dtype))
         h = h + t[:, None, None, :]
-        h = nn.swish(_gn(self.out_ch, "norm2")(h)).astype(self.dtype)
+        h = nn.swish(_gn(self.out_ch, "norm2", eps=1e-5)(h)).astype(self.dtype)
         h = nn.Conv(self.out_ch, (3, 3), padding="SAME", dtype=self.dtype,
                     name="conv2")(h)
         if x.shape[-1] != self.out_ch:
@@ -147,15 +157,15 @@ class TransformerBlock(nn.Module):
         d = x.shape[-1]
         attn = lambda name: nn.MultiHeadDotProductAttention(  # noqa: E731
             num_heads=self.heads, dtype=self.dtype, deterministic=True, name=name)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x).astype(self.dtype)
+        h = _ln("ln1")(x).astype(self.dtype)
         x = x + attn("self_attn")(h, h, h)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x).astype(self.dtype)
+        h = _ln("ln2")(x).astype(self.dtype)
         x = x + attn("cross_attn")(h, ctx, ctx)
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln3")(x).astype(self.dtype)
+        h = _ln("ln3")(x).astype(self.dtype)
         up = nn.Dense(8 * d, dtype=self.dtype, name="ff_up")(h)
         gate, val = jnp.split(up, 2, axis=-1)
         return x + nn.Dense(d, dtype=self.dtype, name="ff_down")(
-            val * nn.gelu(gate))
+            val * nn.gelu(gate, approximate=False))
 
 
 class SpatialTransformer(nn.Module):
@@ -204,7 +214,11 @@ class UNet(nn.Module):
                                            name=f"down{i}_attn{j}")(h, ctx)
                 skips.append(h)
             if i != len(self.mults) - 1:
-                h = nn.Conv(h.shape[-1], (3, 3), strides=(2, 2), padding="SAME",
+                # Explicit (1,1) padding, not SAME: with stride 2, SAME pads
+                # (0,1) while SD's Downsample pads symmetrically — same output
+                # shape, different window alignment (caught by torch parity).
+                h = nn.Conv(h.shape[-1], (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)),
                             dtype=self.dtype, name=f"down{i}_ds")(h)
                 skips.append(h)
         # Middle.
@@ -225,7 +239,7 @@ class UNet(nn.Module):
                 h = jax.image.resize(h, (b, hh * 2, ww * 2, c), method="nearest")
                 h = nn.Conv(c, (3, 3), padding="SAME", dtype=self.dtype,
                             name=f"up{i}_us")(h)
-        h = nn.swish(_gn(h.shape[-1], "norm_out")(h)).astype(self.dtype)
+        h = nn.swish(_gn(h.shape[-1], "norm_out", eps=1e-5)(h)).astype(self.dtype)
         return nn.Conv(4, (3, 3), padding="SAME", dtype=jnp.float32,
                        name="conv_out")(h)
 
@@ -375,6 +389,14 @@ class SD15Serving(ServingModel):
             "unet": self.unet.init(k2, lat, t, ctx),
             "vae": self.vae.init(k3, lat),
         }
+
+    def import_torch_variables(self, flat: dict) -> Any:
+        """Published SD 1.5 single-file checkpoint (LDM layout, safetensors
+        or .ckpt) -> our param tree; see tpuserve.models.sd15_import. Pair
+        with options bpe_vocab/bpe_merges for the real CLIP tokenizer."""
+        from tpuserve.models.sd15_import import import_ldm_checkpoint
+
+        return import_ldm_checkpoint(self, flat)
 
     # -- shapes ---------------------------------------------------------------
     def input_signature(self, bucket: tuple) -> Any:

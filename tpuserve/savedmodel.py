@@ -15,6 +15,12 @@ formats:
   BN, etc.). TF import is lazy: serving from orbax never imports TF.
 - **frozen GraphDef ``.pb``** — 2016-era repos ship these; constants are
   extracted from the graph nodes into the same flat dict.
+- **torch checkpoints** (``.safetensors`` / ``.ckpt`` / ``.pt`` / ``.pth`` /
+  ``.bin``) — how SD 1.5-class artifacts actually ship (VERDICT r3 missing
+  1). Read on CPU (safetensors directly; pickle checkpoints via
+  ``torch.load(weights_only=True)`` so untrusted files cannot execute code)
+  into the same flat ``name -> np.ndarray`` dict, then handed to the
+  family's ``import_torch_variables``.
 
 Detection is by directory shape, so ``ModelConfig.weights`` is just a path.
 Golden-output parity between the TF graph and our Flax path is asserted in
@@ -36,13 +42,15 @@ log = logging.getLogger("tpuserve.savedmodel")
 # -- format detection --------------------------------------------------------
 
 def detect_format(path: str) -> str:
-    """'orbax' | 'saved_model' | 'graphdef'."""
+    """'orbax' | 'saved_model' | 'graphdef' | 'torch'."""
     if os.path.isdir(path):
         if os.path.exists(os.path.join(path, "saved_model.pb")):
             return "saved_model"
         return "orbax"
     if path.endswith(".pb"):
         return "graphdef"
+    if path.endswith((".safetensors", ".ckpt", ".pt", ".pth", ".bin")):
+        return "torch"
     raise ValueError(f"cannot identify weight format of {path!r}")
 
 
@@ -53,6 +61,8 @@ def load_params_for(model) -> Any:
     log.info("loading %s weights for %s from %s", fmt, model.name, path)
     if fmt == "orbax":
         return load_orbax(path, model)
+    if fmt == "torch":
+        return model.import_torch_variables(extract_torch_state_dict(path))
     flat = (
         extract_saved_model_variables(path)
         if fmt == "saved_model"
@@ -105,17 +115,69 @@ def load_orbax(path: str, model) -> Any:
     raw = jax.eval_shape(model.init_params, jax.random.key(0))
     shape_of = lambda x: (tuple(x[qz.QKEY].shape) if qz.is_quantized(x)  # noqa: E731
                           else tuple(x.shape))
+
+    def dtype_ok(g, w) -> bool:
+        # Exact dtype equality is too strict (bf16 vs f32 checkpoints are
+        # both fine — the runtime casts to compute dtype), but a float-slot
+        # leaf restored as int (or vice versa) must fail HERE with guidance,
+        # not later as a cast surprise or compile error (ADVICE r3).
+        # Quantized sub-trees carry their own {q8:int8, q8_scale:float}
+        # dtypes by design.
+        if qz.is_quantized(g):
+            return True
+        # jnp.issubdtype, not np: numpy classifies bfloat16 (kind 'V') as
+        # non-floating, which would reject legitimate bf16 checkpoints.
+        import jax.numpy as jnp
+
+        return (jnp.issubdtype(np.dtype(g.dtype), jnp.floating)
+                == jnp.issubdtype(np.dtype(w.dtype), jnp.floating))
+
     got, got_def = jax.tree_util.tree_flatten_with_path(
         restored, is_leaf=qz.is_quantized)
     want, want_def = jax.tree_util.tree_flatten_with_path(raw)
     if len(got) != len(want) or any(
-            gp != wp or shape_of(g) != tuple(w.shape)
+            gp != wp or shape_of(g) != tuple(w.shape) or not dtype_ok(g, w)
             for (gp, g), (wp, w) in zip(got, want)):
         raise ValueError(
             f"checkpoint at {path!r} does not match {model.name}'s param "
-            "structure; pair the checkpoint with the family/options it was "
-            "converted with")
+            "structure (tree paths, shapes, or dtype classes differ); pair "
+            "the checkpoint with the family/options it was converted with")
     return restored
+
+
+# -- torch checkpoint extraction (lazy torch import) -------------------------
+
+def extract_torch_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Flat {name: np.ndarray} from a torch-ecosystem checkpoint file.
+
+    - ``.safetensors``: read via safetensors (zero pickle exposure).
+    - pickle checkpoints (``.ckpt``/``.pt``/``.pth``/``.bin``): read with
+      ``torch.load(weights_only=True)`` — tensor data only, no arbitrary
+      code execution from untrusted files. LDM-style wrappers that nest the
+      weights under a ``state_dict`` key are unwrapped.
+
+    bf16/f16 tensors are widened to f32 on the host (numpy has no bf16);
+    the runtime casts to the serving compute dtype at device_put anyway.
+    """
+    import torch  # lazy: only on torch-import paths
+
+    if path.endswith(".safetensors"):
+        from safetensors.torch import load_file
+
+        sd = load_file(path, device="cpu")
+    else:
+        obj = torch.load(path, map_location="cpu", weights_only=True)
+        sd = obj.get("state_dict", obj) if isinstance(obj, dict) else obj
+    out: dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        if not isinstance(v, torch.Tensor):
+            continue  # e.g. LDM checkpoints carry step counters
+        if v.dtype in (torch.bfloat16, torch.float16):
+            v = v.float()
+        out[k] = v.numpy()
+    if not out:
+        raise ValueError(f"torch checkpoint at {path!r} holds no tensors")
+    return out
 
 
 # -- TF weight extraction (lazy TF import) -----------------------------------

@@ -111,16 +111,28 @@ class ServerState:
         Canary inferences ride the normal serving path, so they are visible
         in /metrics like any synthetic probe; the per-cycle timeout is
         bounded by the interval so one hung model can't stretch staleness
-        to the startup canary's 60 s budget."""
-        timeout = min(60.0, max(2.0, 2.0 * self.cfg.canary_interval_s))
+        to the startup canary's 60 s budget — but never drops below a
+        model's own request_timeout_ms (ADVICE r3: a 2 s floor made slow
+        models like sd15, ~1.6 s+ device time per image, flap /healthz
+        under ordinary load when canary_interval_s was small)."""
+        timeouts = self.canary_timeouts()
         while True:
             await asyncio.sleep(self.cfg.canary_interval_s)
             try:
-                await self.run_canaries(timeout=timeout)
+                await self.run_canaries(timeouts=timeouts)
             except asyncio.CancelledError:
                 raise
             except Exception:  # one bad cycle must not end re-canarying
                 log.exception("periodic canary cycle failed")
+
+    def canary_timeouts(self) -> dict[str, float]:
+        """Per-model periodic-canary timeout: bounded by the interval but
+        floored at the model's own request_timeout_ms (ADVICE r3)."""
+        base = min(60.0, max(2.0, 2.0 * self.cfg.canary_interval_s))
+        return {
+            name: max(base, m.cfg.request_timeout_ms / 1e3)
+            for name, m in self.models.items()
+        }
 
     async def run_canary(self, name: str, timeout: float = 60.0) -> bool:
         """Tiny end-to-end inference for one model; feeds /healthz."""
@@ -142,10 +154,12 @@ class ServerState:
         # must not KeyError — treat never-measured as healthy.
         return self.canary_ok.get(name, True)
 
-    async def run_canaries(self, timeout: float = 60.0) -> None:
+    async def run_canaries(self, timeout: float = 60.0,
+                           timeouts: dict[str, float] | None = None) -> None:
         # Concurrent: one hung model must not stall (or stale) the others.
         await asyncio.gather(
-            *(self.run_canary(name, timeout=timeout) for name in self.models))
+            *(self.run_canary(name, timeout=(timeouts or {}).get(name, timeout))
+              for name in self.models))
 
     async def stop(self) -> None:
         if self._canary_task is not None:
