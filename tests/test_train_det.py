@@ -1,0 +1,155 @@
+"""EfficientDet fine-tune path (tpuserve.train_det): target assignment
+correctness, loss decrease on the synthetic task, and the headline
+guarantee — the produced orbax checkpoint serves the FULL detector
+end-to-end via ModelConfig.weights (VERDICT r3 next 2's EfficientDet half)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserve.config import ModelConfig
+from tpuserve.models import build
+from tpuserve.models.efficientdet import decode_boxes
+from tpuserve.train_det import (
+    DetTrainConfig,
+    encode_boxes,
+    finetune_detector,
+    make_det_train_state,
+    make_det_train_step,
+    match_anchors,
+    synthetic_det_batch,
+)
+
+
+def det_cfg(**over) -> ModelConfig:
+    base = dict(
+        name="det", family="efficientdet", batch_buckets=[1, 2],
+        deadline_ms=2.0, dtype="float32", parallelism="single",
+        request_timeout_ms=60_000.0, image_size=64, wire_size=64,
+        options=dict(det_classes=5, fpn_channels=16, fpn_repeats=1,
+                     head_repeats=1, max_level=5, pre_nms=32, max_dets=8,
+                     backbone_width=0.25, backbone_depth=0.35,
+                     score_thresh=0.005),
+    )
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def test_encode_decode_roundtrip():
+    """encode_boxes is the exact inverse of the serving decode."""
+    rng = np.random.default_rng(0)
+    anchors = np.stack([
+        rng.uniform(10, 50, 32), rng.uniform(10, 50, 32),
+        rng.uniform(8, 24, 32), rng.uniform(8, 24, 32)], axis=-1).astype(np.float32)
+    boxes = np.stack([
+        rng.uniform(0, 20, 32), rng.uniform(0, 20, 32),
+        rng.uniform(30, 60, 32), rng.uniform(30, 60, 32)], axis=-1).astype(np.float32)
+    reg = encode_boxes(jnp.asarray(boxes), jnp.asarray(anchors))
+    back = decode_boxes(reg, jnp.asarray(anchors), image_size=64) * 64
+    np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-4, atol=1e-3)
+
+
+def test_match_anchors_assignment():
+    # Anchor 0 sits exactly on the GT box; anchor 1 far away; anchor 2 half
+    # overlaps (ignored band).
+    anchors = jnp.asarray([
+        [16.0, 16.0, 16.0, 16.0],   # exact match (IoU 1)
+        [48.0, 48.0, 16.0, 16.0],   # IoU 0 -> background
+        [24.0, 16.0, 16.0, 16.0],   # IoU 1/3 -> ignored band (0.3..0.6)
+    ])
+    boxes = jnp.asarray([[8.0, 8.0, 24.0, 24.0], [0, 0, 0, 0]])
+    classes = jnp.asarray([3, 0], jnp.int32)
+    valid = jnp.asarray([True, False])
+    cls_t, cls_w, box_t, box_w = match_anchors(
+        anchors, boxes, classes, valid, num_classes=5,
+        pos_iou=0.6, neg_iou=0.3)
+    assert box_w[0] == 1.0 and cls_t[0, 3] == 1.0       # positive, class 3
+    assert box_w[1] == 0.0 and cls_w[1] == 1.0          # negative (bg)
+    assert float(jnp.abs(box_t[0]).max()) < 1e-5        # exact match -> zero reg
+    assert cls_w[2] == 0.0                              # ignored band
+    # padded GT slot must not create positives anywhere
+    assert float(cls_t[:, 0].sum()) == 0.0
+
+
+def test_force_match_rescues_low_iou_gt():
+    """A GT overlapping no anchor above pos_iou still claims its best one."""
+    anchors = jnp.asarray([[16.0, 16.0, 32.0, 32.0], [48.0, 48.0, 32.0, 32.0]])
+    boxes = jnp.asarray([[14.0, 14.0, 18.0, 18.0]])  # tiny box, IoU ~0.016
+    cls_t, cls_w, _, box_w = match_anchors(
+        anchors, boxes, jnp.asarray([2], jnp.int32), jnp.asarray([True]),
+        num_classes=5, pos_iou=0.5, neg_iou=0.4)
+    assert box_w[0] == 1.0 and cls_t[0, 2] == 1.0
+
+
+def test_padded_gt_does_not_clobber_forced_match():
+    """Padded GT slots argmax to anchor 0; a plain scatter would overwrite a
+    real GT's force-match there (review regression). The real GT whose best
+    anchor IS anchor 0 must keep its claim."""
+    anchors = jnp.asarray([[16.0, 16.0, 32.0, 32.0], [48.0, 48.0, 32.0, 32.0]])
+    boxes = jnp.asarray([[14.0, 14.0, 18.0, 18.0],   # best anchor 0, low IoU
+                         [0.0, 0.0, 0.0, 0.0],        # padded
+                         [0.0, 0.0, 0.0, 0.0]])       # padded
+    classes = jnp.asarray([4, 0, 0], jnp.int32)
+    valid = jnp.asarray([True, False, False])
+    cls_t, _, _, box_w = match_anchors(
+        anchors, boxes, classes, valid, num_classes=5,
+        pos_iou=0.5, neg_iou=0.4)
+    assert box_w[0] == 1.0 and cls_t[0, 4] == 1.0  # forced match survives
+    assert box_w[1] == 0.0                          # padded slots claim nothing
+
+
+@pytest.mark.slow
+def test_finetune_loss_decreases_and_checkpoint_serves(tmp_path):
+    from tpuserve.parallel import make_mesh
+
+    cfg = det_cfg()
+    serving = build(cfg)
+    mesh = make_mesh()
+    tcfg = DetTrainConfig(lr=3e-3, max_boxes=4)
+    params, tx, opt_state = make_det_train_state(serving, mesh, tcfg)
+    step, _ = make_det_train_step(serving, tx, mesh, tcfg)
+
+    bs = int(mesh.shape["data"])  # batch shards over "data" (8 fake devices)
+    losses = []
+    for i in range(8):
+        batch = synthetic_det_batch(bs, cfg.wire_size, cfg.image_size,
+                                    serving.det_classes, tcfg.max_boxes, seed=i)
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    # The full-path entry: finetune_detector writes a checkpoint that the
+    # serving stack restores as a complete detector (backbone + BiFPN +
+    # heads — nothing seeded).
+    out = str(tmp_path / "det_ckpt")
+    finetune_detector(cfg, out, steps=2, batch_size=2, tcfg=tcfg, log_every=0)
+
+    served = build(det_cfg(name="det2", weights=out))
+    restored = served.load_params()
+    want = jax.eval_shape(served.init_params, jax.random.key(0))
+    assert (jax.tree_util.tree_structure(restored)
+            == jax.tree_util.tree_structure(want))
+    batch = synthetic_det_batch(2, cfg.wire_size, cfg.image_size,
+                                serving.det_classes, 4, seed=99)
+    outp = jax.jit(served.forward)(restored, jnp.asarray(batch["images"]))
+    assert outp["boxes"].shape == (2, 8, 4)
+    assert int(outp["n"][0]) >= 0
+
+
+@pytest.mark.slow
+def test_finetune_det_cli(tmp_path):
+    from tpuserve.cli import main
+
+    out = str(tmp_path / "cli_ckpt")
+    rc = main(["finetune-det", "--out", out, "--steps", "2", "--batch", "2",
+               "--opt", "image_size=64", "--opt", "wire_size=64",
+               "--opt", "det_classes=5", "--opt", "fpn_channels=16",
+               "--opt", "fpn_repeats=1", "--opt", "head_repeats=1",
+               "--opt", "max_level=5", "--opt", "pre_nms=32",
+               "--opt", "max_dets=8", "--opt", "backbone_width=0.25",
+               "--opt", "backbone_depth=0.35"])
+    assert rc == 0
+    import os
+
+    assert os.path.isdir(out)

@@ -22,6 +22,20 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    help="dot-path override, e.g. --set model.resnet50.deadline_ms=2")
 
 
+def _parse_opt_args(parser: argparse.ArgumentParser, items: list[str]) -> dict:
+    """--opt KEY=VALUE pairs -> {key: TOML-parsed value} (import-model and
+    finetune-det share this)."""
+    from tpuserve.config import _parse_toml_value
+
+    options = {}
+    for item in items:
+        if "=" not in item:
+            parser.error(f"--opt must look like key=value, got {item!r}")
+        key, _, text = item.partition("=")
+        options[key.strip()] = _parse_toml_value(text.strip())
+    return options
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="tpuserve")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -56,6 +70,23 @@ def main(argv: list[str] | None = None) -> int:
                        help="write a weight-only int8 checkpoint (half the "
                             "bytes); serve it with quantize = \"int8\"")
 
+    p_ft = sub.add_parser(
+        "finetune-det",
+        help="fine-tune EfficientDet -> full orbax detector checkpoint")
+    p_ft.add_argument("--out", required=True)
+    p_ft.add_argument("--steps", type=int, default=50)
+    p_ft.add_argument("--batch", type=int, default=8)
+    p_ft.add_argument("--data", default=None,
+                      help=".npz with images/boxes/classes/valid; default "
+                           "synthetic rectangles")
+    p_ft.add_argument("--weights", default=None,
+                      help="EfficientNet-B0 backbone checkpoint to transfer "
+                           "from (SavedModel or orbax)")
+    p_ft.add_argument("--lr", type=float, default=1e-3)
+    p_ft.add_argument("--opt", action="append", default=[], metavar="KEY=VALUE",
+                      help="model option/field (TOML-parsed), e.g. "
+                           "--opt image_size=512 --opt det_classes=90")
+
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
 
@@ -85,16 +116,29 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "import-model":
         from tpuserve import savedmodel
-        from tpuserve.config import _parse_toml_value
 
-        options = {}
-        for item in args.opt:
-            if "=" not in item:
-                parser.error(f"--opt must look like key=value, got {item!r}")
-            key, _, text = item.partition("=")
-            options[key.strip()] = _parse_toml_value(text.strip())
+        options = _parse_opt_args(parser, args.opt)
         savedmodel.convert_cli(args.saved_model, args.family, args.out, options,
                                quantize=args.quantize)
+        return 0
+
+    if args.cmd == "finetune-det":
+        import dataclasses
+
+        from tpuserve.config import ModelConfig
+        from tpuserve.train_det import DetTrainConfig, finetune_detector
+
+        opts = _parse_opt_args(parser, args.opt)
+        settable = {f.name for f in dataclasses.fields(ModelConfig)} - {
+            "name", "family", "weights", "options"}
+        fields = {k: opts.pop(k) for k in list(opts) if k in settable}
+        cfg = ModelConfig(name="efficientdet", family="efficientdet",
+                          weights=args.weights, options=opts, **fields)
+        loss = finetune_detector(cfg, args.out, steps=args.steps,
+                                 batch_size=args.batch,
+                                 tcfg=DetTrainConfig(lr=args.lr),
+                                 dataset=args.data)
+        print(json.dumps({"final_loss": loss, "checkpoint": args.out}))
         return 0
 
     if args.cmd == "warmup":

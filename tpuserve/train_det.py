@@ -1,0 +1,296 @@
+"""EfficientDet fine-tune path: produce a FULL detector checkpoint in-framework
+(SURVEY.md §2 C6; VERDICT r3 next 2).
+
+The only TF EfficientDet artifact importable in this environment is the
+EfficientNet-B0 *backbone* (a classification checkpoint —
+``EfficientDetServing.import_tf_variables``); BiFPN and the heads have no
+published TF-executable counterpart here. This module closes the gap the
+standard way detection models are deployed anyway: transfer-learn from the
+imported backbone, fine-tune the whole detector on labeled boxes, and write
+a full orbax checkpoint that serves end-to-end via ``weights = <ckpt>``.
+
+Design (TPU-first, mirrors tpuserve.train's LM step):
+
+- **Anchor matching on device, static shapes**: ground truth arrives padded
+  to ``max_boxes`` per image with a valid mask; IoU matching, target
+  encoding (the exact inverse of ``efficientdet.decode_boxes``), focal and
+  Huber losses are all jittable with no data-dependent shapes, so the whole
+  train step is ONE XLA executable sharded over the mesh "data" axis.
+- RetinaNet-style assignment: IoU >= ``pos_iou`` positive, < ``neg_iou``
+  background, in between ignored (zero loss weight).
+- Sigmoid focal loss (alpha 0.25, gamma 1.5 — the EfficientDet paper's
+  values) normalized by positive count; Huber box loss on positives.
+- BatchNorm statistics stay frozen (``use_running_average=True`` in the
+  modules): standard practice for short fine-tunes and it keeps the serving
+  and training graphs identical.
+
+Synthetic-data mode (no labeled datasets exist in this container) draws
+colored rectangles on noise and asks the detector to find them — a real
+learnable task that exercises the full loss surface; pass an ``.npz`` with
+``images``/``boxes``/``classes``/``valid`` arrays for real data.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class DetTrainConfig:
+    lr: float = 1e-3
+    weight_decay: float = 1e-4
+    focal_alpha: float = 0.25
+    focal_gamma: float = 1.5
+    box_weight: float = 50.0
+    huber_delta: float = 0.1
+    max_boxes: int = 16
+    pos_iou: float = 0.5
+    neg_iou: float = 0.4
+
+
+# -- device-side target assignment -------------------------------------------
+
+def _center_to_corners(a: jax.Array) -> jax.Array:
+    yc, xc, h, w = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    return jnp.stack([yc - h / 2, xc - w / 2, yc + h / 2, xc + w / 2], axis=-1)
+
+
+def _iou_matrix(anchors_c: jax.Array, boxes: jax.Array) -> jax.Array:
+    """(A, 4) corners x (M, 4) corners -> (A, M) IoU."""
+    area_a = jnp.maximum(anchors_c[:, 2] - anchors_c[:, 0], 0) * jnp.maximum(
+        anchors_c[:, 3] - anchors_c[:, 1], 0)
+    area_b = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * jnp.maximum(
+        boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(anchors_c[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(anchors_c[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def encode_boxes(boxes: jax.Array, anchors: jax.Array) -> jax.Array:
+    """Corner GT boxes -> [ty, tx, th, tw] regression targets: the exact
+    inverse of ``efficientdet.decode_boxes`` (in pixels, un-normalized)."""
+    yc = (boxes[:, 0] + boxes[:, 2]) / 2
+    xc = (boxes[:, 1] + boxes[:, 3]) / 2
+    h = jnp.maximum(boxes[:, 2] - boxes[:, 0], 1e-3)
+    w = jnp.maximum(boxes[:, 3] - boxes[:, 1], 1e-3)
+    return jnp.stack([
+        (yc - anchors[:, 0]) / anchors[:, 2],
+        (xc - anchors[:, 1]) / anchors[:, 3],
+        jnp.log(h / anchors[:, 2]),
+        jnp.log(w / anchors[:, 3]),
+    ], axis=-1)
+
+
+def match_anchors(anchors: jax.Array, boxes: jax.Array, classes: jax.Array,
+                  valid: jax.Array, num_classes: int,
+                  pos_iou: float, neg_iou: float):
+    """Per-image static-shape target assignment.
+
+    anchors (A, 4) center-size pixels; boxes (M, 4) corner pixels;
+    classes (M,) int32; valid (M,) bool mask for padded GT slots.
+    Returns cls_target (A, C), cls_weight (A,), box_target (A, 4),
+    box_weight (A,).
+    """
+    anchors_c = _center_to_corners(anchors)
+    iou = _iou_matrix(anchors_c, boxes) * valid[None, :].astype(jnp.float32)
+    best_iou = jnp.max(iou, axis=1, initial=0.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    pos = best_iou >= pos_iou
+    neg = best_iou < neg_iou
+    # Force-match: every valid GT claims its single best anchor even below
+    # pos_iou, so no labeled box is unsupervised (standard RetinaNet detail).
+    # Padded GT slots are routed to an out-of-range index and dropped — their
+    # argmax degenerates to anchor 0 and a plain scatter would clobber a real
+    # GT's claim there (duplicate-index .at[].set ordering is undefined).
+    # Deterministic tie-break when two valid GTs share a best anchor: both
+    # scatters take the max, so the highest GT index wins consistently.
+    a_star = jnp.where(valid, jnp.argmax(iou, axis=0), anchors.shape[0])
+    forced = jnp.zeros(anchors.shape[0], bool).at[a_star].max(
+        valid, mode="drop")
+    forced_gt = jnp.zeros(anchors.shape[0], jnp.int32).at[a_star].max(
+        jnp.arange(boxes.shape[0], dtype=jnp.int32), mode="drop")
+    pos = pos | forced
+    best_gt = jnp.where(forced & (best_iou < pos_iou), forced_gt, best_gt)
+
+    cls_of = classes[best_gt]
+    cls_target = jax.nn.one_hot(cls_of, num_classes) * pos[:, None]
+    cls_weight = (pos | neg).astype(jnp.float32)
+    box_target = encode_boxes(boxes[best_gt], anchors)
+    return cls_target, cls_weight, box_target, pos.astype(jnp.float32)
+
+
+# -- losses -------------------------------------------------------------------
+
+def focal_loss(logits, targets, weight, alpha, gamma):
+    """Sigmoid focal CE, summed; (B, A, C) logits vs one-hot targets."""
+    p = jax.nn.sigmoid(logits)
+    ce = optax.sigmoid_binary_cross_entropy(logits, targets)
+    p_t = p * targets + (1 - p) * (1 - targets)
+    a_t = alpha * targets + (1 - alpha) * (1 - targets)
+    return jnp.sum(a_t * ((1 - p_t) ** gamma) * ce * weight[..., None])
+
+
+def det_loss_fn(serving, params, batch, tcfg: DetTrainConfig):
+    """Full detector loss for a padded batch dict (jittable)."""
+    x = serving.prepare_batch(batch["images"])
+    cls_logits, box_reg = serving.module.apply(params, x)
+    cls_logits = cls_logits.astype(jnp.float32)
+    box_reg = box_reg.astype(jnp.float32)
+
+    match = jax.vmap(partial(
+        match_anchors, serving.anchors, num_classes=serving.det_classes,
+        pos_iou=tcfg.pos_iou, neg_iou=tcfg.neg_iou))
+    cls_t, cls_w, box_t, box_w = match(
+        batch["boxes"], batch["classes"], batch["valid"])
+
+    n_pos = jnp.maximum(jnp.sum(box_w), 1.0)
+    cls_loss = focal_loss(cls_logits, cls_t, cls_w,
+                          tcfg.focal_alpha, tcfg.focal_gamma) / n_pos
+    huber = optax.huber_loss(box_reg, box_t, delta=tcfg.huber_delta)
+    box_loss = jnp.sum(huber * box_w[..., None]) / n_pos
+    return cls_loss + tcfg.box_weight * box_loss
+
+
+# -- train state / step -------------------------------------------------------
+
+def make_det_train_state(serving, mesh: Mesh, tcfg: DetTrainConfig):
+    """Params from serving.load_params() (backbone import happens there when
+    cfg.weights points at an EfficientNet checkpoint); replicated over the
+    mesh; adamw over the "params" collection only (batch_stats frozen)."""
+    params = serving.load_params()
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    tx = optax.adamw(tcfg.lr, weight_decay=tcfg.weight_decay)
+    opt_state = tx.init(params["params"])
+    return params, tx, opt_state
+
+
+def make_det_train_step(serving, tx, mesh: Mesh, tcfg: DetTrainConfig):
+    replicated = NamedSharding(mesh, P())
+    batch_sharding = {
+        "images": NamedSharding(mesh, P("data")),
+        "boxes": NamedSharding(mesh, P("data")),
+        "classes": NamedSharding(mesh, P("data")),
+        "valid": NamedSharding(mesh, P("data")),
+    }
+
+    def step(params, opt_state, batch):
+        def loss_of(trainable):
+            full = dict(params)
+            full["params"] = trainable
+            return det_loss_fn(serving, full, batch, tcfg)
+
+        loss, grads = jax.value_and_grad(loss_of)(params["params"])
+        updates, opt_state = tx.update(grads, opt_state, params["params"])
+        new_params = dict(params)
+        new_params["params"] = optax.apply_updates(params["params"], updates)
+        return new_params, opt_state, loss
+
+    return jax.jit(
+        step,
+        in_shardings=(replicated, None, batch_sharding),
+        out_shardings=(replicated, None, None),
+        donate_argnums=(0, 1),
+    ), batch_sharding
+
+
+# -- data ---------------------------------------------------------------------
+
+def synthetic_det_batch(batch_size: int, wire: int, image_size: int,
+                        num_classes: int, max_boxes: int, seed: int = 0) -> dict:
+    """Colored rectangles on noise: class = color index. Box coords are in
+    MODEL pixels (image_size), images at the wire shape — matching serving,
+    where the host ships wire-sized uint8 and the device resizes."""
+    rng = np.random.default_rng(seed)
+    images = rng.integers(0, 64, (batch_size, wire, wire, 3), np.uint8)
+    boxes = np.zeros((batch_size, max_boxes, 4), np.float32)
+    classes = np.zeros((batch_size, max_boxes), np.int32)
+    valid = np.zeros((batch_size, max_boxes), bool)
+    palette = np.linspace(96, 255, max(num_classes, 2)).astype(np.uint8)
+    for b in range(batch_size):
+        for m in range(rng.integers(1, min(3, max_boxes) + 1)):
+            c = int(rng.integers(0, num_classes))
+            h = int(rng.integers(wire // 4, wire // 2))
+            w = int(rng.integers(wire // 4, wire // 2))
+            y0 = int(rng.integers(0, wire - h))
+            x0 = int(rng.integers(0, wire - w))
+            images[b, y0:y0 + h, x0:x0 + w] = palette[c]
+            scale = image_size / wire
+            boxes[b, m] = (y0 * scale, x0 * scale,
+                           (y0 + h) * scale, (x0 + w) * scale)
+            classes[b, m] = c
+            valid[b, m] = True
+    return {"images": images, "boxes": boxes, "classes": classes,
+            "valid": valid}
+
+
+def load_npz_dataset(path: str) -> dict:
+    """User data: .npz with images (N,E,E,3 u8), boxes (N,M,4 f32, model-pixel
+    corners), classes (N,M i32), valid (N,M bool)."""
+    z = np.load(path)
+    need = {"images", "boxes", "classes", "valid"}
+    missing = need - set(z.files)
+    if missing:
+        raise ValueError(f"npz dataset missing arrays: {sorted(missing)}")
+    return {k: z[k] for k in need}
+
+
+# -- entry point --------------------------------------------------------------
+
+def finetune_detector(cfg, out_path: str, steps: int = 50, batch_size: int = 8,
+                      tcfg: DetTrainConfig | None = None,
+                      dataset: str | None = None, log_every: int = 10,
+                      mesh: Mesh | None = None) -> float:
+    """Fine-tune the detector and write a full orbax checkpoint to out_path.
+
+    cfg: an EfficientDet ModelConfig; cfg.weights may point at an
+    EfficientNet-B0 classification checkpoint (backbone transfer) or be
+    unset (from-scratch tiny runs/tests). Returns the final loss.
+    """
+    from tpuserve import savedmodel
+    from tpuserve.models import build
+    from tpuserve.parallel import make_mesh
+
+    tcfg = tcfg or DetTrainConfig()
+    if cfg.wire_format != "rgb8":
+        # prepare_batch would try to unpack YUV plane tuples from the single
+        # (B, E, E, 3) training array — crash or silent garbage training.
+        raise ValueError(
+            "finetune_detector trains on rgb8 wire batches; set "
+            'wire_format = "rgb8" for training (the serving config can still '
+            "use yuv420 — weights are wire-format independent)")
+    serving = build(cfg)
+    mesh = mesh or make_mesh()
+    # Batch shards over the mesh "data" axis; round up so it divides.
+    d = int(mesh.shape["data"])
+    batch_size = max(d, -(-batch_size // d) * d)
+    params, tx, opt_state = make_det_train_state(serving, mesh, tcfg)
+    step, _ = make_det_train_step(serving, tx, mesh, tcfg)
+
+    data = load_npz_dataset(dataset) if dataset else None
+    n = data["images"].shape[0] if data else 0
+    loss = float("nan")
+    for i in range(steps):
+        if data:
+            idx = np.random.default_rng(i).integers(0, n, batch_size)
+            batch = {k: v[idx] for k, v in data.items()}
+        else:
+            batch = synthetic_det_batch(
+                batch_size, cfg.wire_size, cfg.image_size,
+                serving.det_classes, tcfg.max_boxes, seed=i)
+        params, opt_state, loss = step(params, opt_state, batch)
+        if log_every and (i + 1) % log_every == 0:
+            print(f"# det finetune step {i + 1}/{steps}: loss {float(loss):.4f}")
+    savedmodel.save_orbax(out_path, jax.device_get(params))
+    return float(loss)
