@@ -90,7 +90,10 @@ def test_train_step_with_ring_attention():
 def test_long_context_serving_2048():
     """Long-context serving end-to-end: a (batch, 2048) bucket with ring
     attention over sp=4, the whole-path proof that sequence parallelism
-    extends serving past the BERT-512 regime."""
+    extends serving past the BERT-512 regime. head_dim 64 makes the ring's
+    auto local_impl run every per-device block through the Pallas flash
+    kernel (512-row local blocks, lane-aligned head_dim) — the flagship
+    composition: SP ring over ICI, fused kernel inside each device."""
     from tpuserve.config import ModelConfig
     from tpuserve.models import build
     from tpuserve.runtime import build_runtime
@@ -99,7 +102,7 @@ def test_long_context_serving_2048():
     cfg = ModelConfig(
         name="bert-long", family="bert", parallelism="sharded", sp=4,
         batch_buckets=[2], seq_buckets=[2048], dtype="float32", num_classes=4,
-        options={"layers": 1, "d_model": 32, "heads": 4, "d_ff": 64,
+        options={"layers": 1, "d_model": 256, "heads": 4, "d_ff": 64,
                  "vocab_size": 512, "attention": "ring"},
     )
     model = build(cfg)

@@ -61,8 +61,34 @@ class BertBlock(nn.Module):
             from tpuserve.ops.flash_attention import flash_attention
 
             # mask_bias is (B, 1, 1, S) additive; flash takes per-key (B, S).
-            fn = lambda q, k, v, **kw: flash_attention(  # noqa: E731
-                q, k, v, mask_bias[:, 0, 0, :])
+            if self.mesh is not None:
+                # Sharded serving: GSPMD cannot auto-partition a Mosaic
+                # kernel, so shard_map runs it per device on the local shard
+                # (batch on "data", heads on "model" when tp divides them) —
+                # the supported composition that used to be a build-time
+                # rejection (VERDICT r3 next 3).
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                head_axis = ("model"
+                             if self.heads % self.mesh.shape["model"] == 0
+                             else None)
+                qkv_spec = P("data", None, head_axis, None)
+
+                def fn(q, k, v, **kw):  # noqa: ANN001
+                    f = shard_map(
+                        lambda q_, k_, v_, b_: flash_attention(q_, k_, v_, b_),
+                        mesh=self.mesh,
+                        in_specs=(qkv_spec, qkv_spec, qkv_spec,
+                                  P("data", None)),
+                        out_specs=qkv_spec,
+                        # Pallas interpreter + vma tracking don't compose
+                        # (see tpuserve.ops.ring_attention).
+                        check_vma=False)
+                    return f(q, k, v, mask_bias[:, 0, 0, :])
+            else:
+                fn = lambda q, k, v, **kw: flash_attention(  # noqa: E731
+                    q, k, v, mask_bias[:, 0, 0, :])
         elif self.attention_impl in ("ring", "ulysses"):
             from jax.sharding import PartitionSpec as P
 
@@ -164,15 +190,9 @@ class BertServing(ServingModel):
         if attention not in ("dense", "flash", "ring", "ulysses"):
             raise ValueError("options.attention must be 'dense', 'flash', "
                              f"'ring', or 'ulysses', got {attention!r}")
-        if (attention == "flash" and cfg.parallelism == "sharded"
-                and jax.default_backend() == "tpu" and len(jax.devices()) > 1):
-            # Mosaic kernels can't be auto-partitioned by a multi-device jit
-            # (jax tpu_custom_call raises NotImplementedError at compile);
-            # fail at build time with guidance instead of at server startup.
-            raise ValueError(
-                "options.attention='flash' requires parallelism='replica' or "
-                "'single' on a multi-chip mesh (Pallas kernels are not "
-                "auto-partitioned under a sharded jit)")
+        # attention='flash' + parallelism='sharded' is supported: bind_mesh
+        # routes the kernel through shard_map (GSPMD can't auto-partition a
+        # Mosaic call; per-device local execution is the composition).
         if attention in ("ring", "ulysses"):
             if cfg.parallelism == "replica":
                 # One shared module can't close over N per-replica meshes;
@@ -240,8 +260,12 @@ class BertServing(ServingModel):
         self.top_k = min(5, cfg.num_classes)
 
     def bind_mesh(self, mesh: Any) -> None:
-        """Sequence-parallel attention closes over the serving mesh."""
-        if self.module.attention_impl in ("ring", "ulysses"):
+        """Mesh-aware attention closes over the serving mesh: ring/ulysses
+        always; flash only in sharded mode (it shard_maps over the mesh —
+        replica/single modes call the kernel directly)."""
+        if self.module.attention_impl in ("ring", "ulysses") or (
+                self.module.attention_impl == "flash"
+                and self.cfg.parallelism == "sharded"):
             self.module = self.module.clone(mesh=mesh)
 
     def import_tf_variables(self, flat: dict) -> Any:

@@ -50,11 +50,10 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
-               m_ref, l_ref, acc_ref, *, scale: float):
-    """One (query tile, key tile) grid cell; state carried in VMEM scratch."""
+def _fa_step(q_ref, k_ref, v_ref, bias_ref, m_ref, l_ref, acc_ref,
+             scale: float) -> None:
+    """Shared online-softmax update for one (query tile, key tile) cell."""
     ki = pl.program_id(2)
-    nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _():
@@ -82,22 +81,166 @@ def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(ki == nk - 1)
+
+def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+               m_ref, l_ref, acc_ref, *, scale: float):
+    """Standard variant: normalized output only."""
+    _fa_step(q_ref, k_ref, v_ref, bias_ref, m_ref, l_ref, acc_ref, scale)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
     def _():
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def _fa_kernel_stats(q_ref, k_ref, v_ref, bias_ref, o_ref, mo_ref, lo_ref,
+                     m_ref, l_ref, acc_ref, *, scale: float):
+    """Stats variant: emit the UNNORMALIZED f32 accumulator plus the
+    online-softmax (m, l) per query row so a caller can merge this block's
+    result with other blocks' — the recurrence ring attention runs ACROSS
+    chips (blockwise-parallel combine). No divide happens in-kernel: a
+    fully-masked block (l == 0) stays a harmless zero contribution instead
+    of 0/0 NaN, and the caller's f32 merge never round-trips through the
+    input dtype."""
+    _fa_step(q_ref, k_ref, v_ref, bias_ref, m_ref, l_ref, acc_ref, scale)
+
+    @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)
+    def _():
+        o_ref[0] = acc_ref[:]
+        mo_ref[0] = m_ref[:]
+        lo_ref[0] = l_ref[:]
+
+
+def _dense_stats(q, k, v, bias, return_stats):
+    """Pure-XLA twin of the kernel's math: the VJP reference.
+
+    Same function value as the kernel (scores = scaled q.k + per-key bias,
+    online softmax); used only to define gradients, so the O(S^2) score
+    materialization here costs backward passes, never serving."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    s = s + bias[:, None, None, :].astype(jnp.float32)
+    m = jnp.max(s, axis=-1)                              # (B, H, Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    if return_stats:
+        return (acc, m.transpose(0, 2, 1), l.transpose(0, 2, 1))
+    return (acc / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, bias, block_q, block_k, interpret, return_stats):
+    """Kernel dispatch with a dense-recompute VJP: forward runs the Pallas
+    kernel; backward differentiates the mathematically-identical dense
+    reference (a fused backward kernel is future work — training through
+    flash pays the dense O(S^2) memory, serving never does)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    nk = sk // block_k
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    biasf = bias.astype(jnp.float32).reshape(b, nk, 1, block_k)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, 1, 1, block_k),
+                     lambda bh, qi, ki, h=h: (bh // h, ki, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    scratch = [
+        pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+        pltpu.VMEM((block_q, 128), jnp.float32),   # normalizer l
+        pltpu.VMEM((block_q, d), jnp.float32),     # weighted accumulator
+    ]
+    grid = (b * h, sq // block_q, nk)
+
+    # Inside shard_map (the sharded-BERT / ring-local composition) outputs
+    # must declare which mesh axes they vary over; inherit the inputs' union
+    # (outside shard_map these are empty sets — no-op).
+    vma = frozenset()
+    for x in (q, k, v, bias):
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+
+    def out_struct(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+    if not return_stats:
+        out = pl.pallas_call(
+            functools.partial(_fa_kernel, scale=d ** -0.5),
+            grid=grid, in_specs=in_specs, out_specs=o_spec,
+            out_shape=out_struct((b * h, sq, d), q.dtype),
+            scratch_shapes=scratch, interpret=interpret,
+        )(qf, kf, vf, biasf)
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+    # Stats outputs mirror the scratch layout: (B*H, Sq, 128) f32 with the
+    # row value broadcast along the 128 lane dim (Mosaic-aligned tiles);
+    # lane 0 is sliced out after the call. The accumulator comes back
+    # UNNORMALIZED in f32 (see _fa_kernel_stats).
+    stat_spec = pl.BlockSpec((1, block_q, 128), lambda bh, qi, ki: (bh, qi, 0))
+    out, m, l = pl.pallas_call(
+        functools.partial(_fa_kernel_stats, scale=d ** -0.5),
+        grid=grid, in_specs=in_specs,
+        out_specs=(o_spec, stat_spec, stat_spec),
+        out_shape=(out_struct((b * h, sq, d), jnp.float32),
+                   out_struct((b * h, sq, 128), jnp.float32),
+                   out_struct((b * h, sq, 128), jnp.float32)),
+        scratch_shapes=scratch, interpret=interpret,
+    )(qf, kf, vf, biasf)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    m = m[..., 0].reshape(b, h, sq).transpose(0, 2, 1)   # (B, Sq, H)
+    l = l[..., 0].reshape(b, h, sq).transpose(0, 2, 1)
+    return out, m, l
+
+
+def _flash_fwd(q, k, v, bias, block_q, block_k, interpret, return_stats):
+    out = _flash(q, k, v, bias, block_q, block_k, interpret, return_stats)
+    return out, (q, k, v, bias)
+
+
+def _flash_bwd(block_q, block_k, interpret, return_stats, res, ct):
+    q, k, v, bias = res
+    _, vjp = jax.vjp(
+        lambda a, b_, c, d_: _dense_stats(a, b_, c, d_, return_stats),
+        q, k, v, bias)
+    return vjp(ct)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_q", "block_k", "interpret",
+                                    "return_stats"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     bias: jax.Array | None = None, *,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: bool | None = None) -> jax.Array:
+                    interpret: bool | None = None,
+                    return_stats: bool = False):
     """Blockwise fused attention, (B, S, H, D) in/out.
 
     ``bias``: optional additive per-key scores, (B, Sk) — e.g. a padding
     mask's (1 - mask) * -1e9. Block sizes clamp to divisors of the sequence
     lengths (exact for power-of-two-aligned buckets like {64, 128, 256, 512};
     192/320-style buckets fall back to 64-row blocks).
+
+    Differentiable: the VJP recomputes through the dense reference
+    (O(S^2) memory on backward only — fine for fine-tuning, not for
+    long-context pretraining; a fused backward kernel is the upgrade path).
+
+    ``return_stats=True`` returns ``(acc, m, l)`` — the UNNORMALIZED f32
+    accumulator plus the online-softmax row stats (B, Sq, H) — letting the
+    caller merge this result with other key blocks (ring attention's
+    per-device inner step) without NaN on fully-masked blocks and without
+    rounding partial results to the input dtype. The merge is::
+
+        m12 = max(m1, m2); a1 = exp(m1-m12); a2 = exp(m2-m12)
+        l12 = l1*a1 + l2*a2
+        o12 = (acc1*a1 + acc2*a2) / l12
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -120,35 +263,4 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret = platform != "tpu"
     if bias is None:
         bias = jnp.zeros((b, sk), jnp.float32)
-
-    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head). Bias is
-    # pre-split into k blocks, (B, nk, 1, block_k), so every BlockSpec's last
-    # two dims equal the array's (the TPU divisible-or-whole rule) and the
-    # kernel never slices dynamically.
-    nk = sk // block_k
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    biasf = bias.astype(jnp.float32).reshape(b, nk, 1, block_k)
-
-    kernel = functools.partial(_fa_kernel, scale=d ** -0.5)
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, sq // block_q, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, 1, 1, block_k),
-                         lambda bh, qi, ki, h=h: (bh // h, ki, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
-            pltpu.VMEM((block_q, 128), jnp.float32),   # normalizer l
-            pltpu.VMEM((block_q, d), jnp.float32),     # weighted accumulator
-        ],
-        interpret=interpret,
-    )(qf, kf, vf, biasf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return _flash(q, k, v, bias, block_q, block_k, interpret, return_stats)

@@ -37,8 +37,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuserve.ops.ring_attention import dense_attention
 
 
-def _ulysses_body(q, k, v, kbias, axis_name: str):
-    """Per-device: reshard seq->heads, dense-attend the full sequence, back."""
+def _ulysses_body(q, k, v, kbias, axis_name: str, local_impl: str = "dense"):
+    """Per-device: reshard seq->heads, attend the full sequence, reshard back.
+
+    ``local_impl="flash"`` runs the per-device full-sequence attention
+    through the fused Pallas kernel instead of a dense einsum — the local
+    (H/n, S, S) score materialization was Ulysses's memory weak spot."""
     a2a = partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
     # (B, S/n, H, D) -> (B, S, H/n, D): split the heads dim across the axis,
     # concatenate the sequence back together.
@@ -47,7 +51,13 @@ def _ulysses_body(q, k, v, kbias, axis_name: str):
     vh = a2a(v, split_axis=2, concat_axis=1)
     # Per-key bias needs the full sequence on every device.
     bias = jax.lax.all_gather(kbias, axis_name, axis=1, tiled=True)  # (B, S)
-    out = dense_attention(qh, kh, vh, bias[:, None, None, :].astype(jnp.float32))
+    if local_impl == "flash":
+        from tpuserve.ops.flash_attention import flash_attention
+
+        out = flash_attention(qh, kh, vh, bias.astype(jnp.float32))
+    else:
+        out = dense_attention(qh, kh, vh,
+                              bias[:, None, None, :].astype(jnp.float32))
     # (B, S, H/n, D) -> (B, S/n, H, D): the inverse deal. Cast back first:
     # the f32 bias promoted the scores, but the op's contract (shared with
     # ring_attention) is out.dtype == q.dtype.
@@ -57,7 +67,8 @@ def _ulysses_body(q, k, v, kbias, axis_name: str):
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       mesh: Mesh, axis_name: str = "seq",
                       key_padding: jax.Array | None = None,
-                      spec: P | None = None) -> jax.Array:
+                      spec: P | None = None,
+                      local_impl: str = "auto") -> jax.Array:
     """Sequence-parallel attention via head all-to-all; ring_attention's twin.
 
     Args:
@@ -86,11 +97,18 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"ulysses needs local heads ({h}) divisible by the {axis_name!r} "
             f"axis size ({n}); use ring_attention for this shape")
+    if local_impl == "auto":
+        d, s = q.shape[-1], q.shape[1]
+        local_impl = "flash" if d % 64 == 0 and s % 8 == 0 else "dense"
+    elif local_impl not in ("dense", "flash"):
+        raise ValueError(f"unknown local_impl {local_impl!r}")
     bias_spec = P(qkv_spec[0], axis_name)
     fn = shard_map(
-        partial(_ulysses_body, axis_name=axis_name),
+        partial(_ulysses_body, axis_name=axis_name, local_impl=local_impl),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, bias_spec),
         out_specs=qkv_spec,
+        # See ring_attention: the Pallas interpreter needs check_vma off.
+        check_vma=local_impl != "flash",
     )
     return fn(q, k, v, key_padding)
