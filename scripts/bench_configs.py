@@ -2,10 +2,11 @@
 """Measure benchmark configs 2-5 end-to-end over HTTP on the local chip.
 
 BASELINE.json names five judged configs; `bench.py` measures config 1
-(ResNet-50, the headline metric). This script produces measured rows for the
-other four — MobileNetV3-Large (replica/latency mode), BERT-base (text,
-(batch, seq) buckets), EfficientDet-D0 (detection + on-device NMS), and
-Stable Diffusion 1.5 (txt2img, device-resident denoise loop) — using the
+(ResNet-50, the headline metric). This script produces measured rows for
+the others — MobileNetV3-Large (replica/latency mode), BERT-base (text,
+(batch, seq) buckets), its Switch-MoE expert-parallel variant (bert-moe),
+EfficientDet-D0 (detection + on-device NMS), and Stable Diffusion 1.5
+(txt2img, device-resident denoise loop) — using the
 same method as bench.py: real aiohttp server, out-of-process load generator,
 closed-loop peak + per-phase breakdown on stderr. Results are recorded in
 BASELINE.md ("Per-config measured rows").
@@ -14,7 +15,7 @@ Run one family in this process (it owns the TPU for its lifetime):
 
     python scripts/bench_configs.py --family bert
 
-Run all four sequentially (each in a fresh subprocess so param memory and
+Run all five sequentially (each in a fresh subprocess so param memory and
 the PJRT session are released between families):
 
     python scripts/bench_configs.py
@@ -49,6 +50,18 @@ FAMILIES: dict[str, dict] = {
         model=dict(name="bert", family="bert", batch_buckets=[8, 16, 32],
                    seq_buckets=[64, 128], deadline_ms=10.0, dtype="bfloat16",
                    request_timeout_ms=60_000.0),
+        payload="text", verb="classify", concurrency=96, duration=15.0,
+    ),
+    # Switch-MoE BERT (expert-parallel serving variant): same load shape as
+    # the dense row so the MoE overhead is directly readable (VERDICT r3
+    # weak 8 — EP had no bench row). 8 experts, top-1 routing; on one chip
+    # the experts are resident (no all-to-all); on a tp>1 mesh the expert
+    # dim shards over "model".
+    "bert-moe": dict(
+        model=dict(name="bert-moe", family="bert", batch_buckets=[8, 16, 32],
+                   seq_buckets=[64, 128], deadline_ms=10.0, dtype="bfloat16",
+                   request_timeout_ms=60_000.0,
+                   options={"moe_experts": 8}),
         payload="text", verb="classify", concurrency=96, duration=15.0,
     ),
     "efficientdet": dict(
@@ -165,7 +178,7 @@ def main() -> int:
     if args.family:
         return run_family(args.family)
     rc = 0
-    for name in ("mobilenetv3", "bert", "efficientdet", "sd15"):
+    for name in ("mobilenetv3", "bert", "bert-moe", "efficientdet", "sd15"):
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--family", name],
             cwd=REPO)

@@ -259,6 +259,41 @@ def test_efficientdet_backbone_import_parity(effb0_savedmodel):
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
+def test_int8_accuracy_on_imported_weights(keras_savedmodel):
+    """Close quantize.py's 'sub-percent movement' claim on IMPORTED weights
+    (VERDICT r3 next 6): weight-only int8 over the real TF-imported
+    ResNet-50 must keep top-1 identical to the bf16 serving path and move
+    the class distribution by < 1% absolute. (Weights are randomized — no
+    pretrained artifacts in this container — but the import path, layouts,
+    and quantization math are the production ones.)"""
+    import jax.numpy as jnp
+
+    from tpuserve import quantize as qz
+
+    _, path = keras_savedmodel
+    cfg = serving_cfg(weights=path)
+    cfg.dtype = "bfloat16"
+    model = build(cfg)
+    params = model.load_params()
+
+    x = np.random.default_rng(2).uniform(0, 1, (4, 224, 224, 3)).astype(np.float32)
+    y_bf16 = np.asarray(jax.jit(model.module.apply)(
+        params, x)).astype(np.float32)
+
+    qparams = qz.quantize_tree(jax.device_get(params))
+    y_int8 = np.asarray(jax.jit(lambda p, xx: model.module.apply(
+        qz.dequantize_tree(p, jnp.bfloat16), xx))(qparams, x)).astype(np.float32)
+
+    p_bf16 = np.asarray(jax.nn.softmax(y_bf16, axis=-1))
+    p_int8 = np.asarray(jax.nn.softmax(y_int8, axis=-1))
+    drift = float(np.abs(p_int8 - p_bf16).max())
+    rel_logit = float(np.abs(y_int8 - y_bf16).max() / np.abs(y_bf16).max())
+    print(f"# int8-vs-bf16 on imported ResNet-50: top-1 equal, "
+          f"max prob drift {drift:.4f}, rel logit drift {rel_logit:.4f}")
+    assert (y_int8.argmax(-1) == y_bf16.argmax(-1)).all()
+    assert drift < 1e-2, drift  # "sub-percent movement", measured not claimed
+
+
 def test_bf16_serving_close_to_tf(keras_savedmodel):
     """The production dtype (bf16 convs) stays within the SURVEY bf16 budget
     (<=1e-2) of the TF-f32 reference."""
