@@ -176,3 +176,36 @@ def test_recycle_serves_over_http_from_toml(tmp_path):
 
     loop.run_until_complete(go())
     loop.close()
+
+
+def test_warm_pool_replenishes_in_background():
+    """Activation consumes warm workers; the pool must top itself back up in
+    the background so later rotations find a prewarmed successor instead of
+    paying a synchronous spawn (stats: workers_prespawned moves, and many
+    rotations don't mean many dry respawns)."""
+    cfg = make_cfg(relay_workers=2, relay_epoch_images=4, relay_epoch_ms=5_000.0)
+    model = build(cfg)
+    pool = DeferredPool(cfg, "", model)
+    pool.prewarm()
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(pool.start())
+    try:
+        async def go():
+            futs = []
+            # 6 epochs of one full 4-row batch each: the 2 prewarmed workers
+            # cover the first two; the rest need replenished spares.
+            for i in range(6):
+                futs.append(await pool.enqueue((4,), batch(i)))
+            outs = await asyncio.wait_for(asyncio.gather(*futs), timeout=120)
+            assert len(outs) == 6
+            # allow the last background spawn to land
+            for _ in range(100):
+                if pool.stats["workers_prespawned"] >= 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert pool.stats["workers_prespawned"] >= 2, pool.stats
+
+        loop.run_until_complete(go())
+    finally:
+        loop.run_until_complete(pool.stop())
+        loop.close()
