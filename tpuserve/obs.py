@@ -75,9 +75,15 @@ class Histogram:
                 prev_acc = acc
                 acc += c
                 if acc >= rank and c > 0:
+                    if i == len(self.bounds):
+                        # Rank lands in the +Inf overflow bucket: report inf
+                        # rather than clamping to bounds[-1], so a tail of
+                        # hung >100 s requests is visible as saturation in
+                        # /metrics instead of masquerading as a real 100 s
+                        # p99 (ADVICE r4).
+                        return float("inf")
                     lo = self.bounds[i - 1] if i > 0 else 0.0
-                    hi = self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
-                    return lo + (hi - lo) * (rank - prev_acc) / c
+                    return lo + (self.bounds[i] - lo) * (rank - prev_acc) / c
         return self.bounds[-1]
 
     def snapshot(self) -> dict:
@@ -235,12 +241,21 @@ class Metrics:
         for name, g in gauges.items():
             out["gauges"][name] = g.value
         for name, h in hists.items():
-            out["latency"][name] = {
+            p50, p99 = h.quantile(0.5), h.quantile(0.99)
+            # quantile() returns inf when the rank lands in the +Inf overflow
+            # bucket; json.dumps would emit the invalid-JSON token `Infinity`
+            # and break every strict /stats consumer. Cap to the top bound
+            # and say so explicitly instead.
+            sat = not (math.isfinite(p50) and math.isfinite(p99))
+            row = {
                 "n": h.n,
                 "mean_ms": (h.total / h.n) if h.n else 0.0,
-                "p50_ms": h.quantile(0.5),
-                "p99_ms": h.quantile(0.99),
+                "p50_ms": min(p50, h.bounds[-1]),
+                "p99_ms": min(p99, h.bounds[-1]),
             }
+            if sat:
+                row["saturated"] = True
+            out["latency"][name] = row
         return out
 
 

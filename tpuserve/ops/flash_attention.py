@@ -97,10 +97,15 @@ def _fa_kernel_stats(q_ref, k_ref, v_ref, bias_ref, o_ref, mo_ref, lo_ref,
     """Stats variant: emit the UNNORMALIZED f32 accumulator plus the
     online-softmax (m, l) per query row so a caller can merge this block's
     result with other blocks' — the recurrence ring attention runs ACROSS
-    chips (blockwise-parallel combine). No divide happens in-kernel: a
-    fully-masked block (l == 0) stays a harmless zero contribution instead
-    of 0/0 NaN, and the caller's f32 merge never round-trips through the
-    input dtype."""
+    chips (blockwise-parallel combine). No divide happens in-kernel, which
+    keeps fully-masked blocks harmless two different ways depending on the
+    mask encoding (do NOT use l == 0 to detect masked blocks): with a true
+    -inf bias the exps underflow and l really is 0, so skipping the divide
+    avoids 0/0; with the conventional -1e9 padding bias (BERT masks) l is
+    ~block_k and m is ~-1e9 — the zero contribution then comes from the
+    exp(m_blk - m_new) weight underflowing in the CALLER'S merge against
+    any live block. Either way the f32 accumulator never round-trips
+    through the input dtype."""
     _fa_step(q_ref, k_ref, v_ref, bias_ref, m_ref, l_ref, acc_ref, scale)
 
     @pl.when(pl.program_id(2) == pl.num_programs(2) - 1)

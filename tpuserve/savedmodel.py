@@ -62,7 +62,23 @@ def load_params_for(model) -> Any:
     if fmt == "orbax":
         return load_orbax(path, model)
     if fmt == "torch":
-        return model.import_torch_variables(extract_torch_state_dict(path))
+        try:
+            state = extract_torch_state_dict(path)
+        except Exception as e:
+            if path.endswith(".bin"):
+                # '.bin' is only *assumed* torch (pytorch_model.bin is the
+                # common case); a GGML/raw-blob .bin fails torch parsing —
+                # give the unidentified-format guidance instead of a bare
+                # unpickling trace (ADVICE r4).
+                raise ValueError(
+                    f"cannot identify weight format of {path!r}: tried the "
+                    f"torch loader for the '.bin' suffix but it failed "
+                    f"({type(e).__name__}: {e}); supported formats are orbax "
+                    f"dirs, TF SavedModel dirs, GraphDef .pb, and torch "
+                    f".safetensors/.ckpt/.pt/.pth/.bin"
+                ) from e
+            raise
+        return model.import_torch_variables(state)
     flat = (
         extract_saved_model_variables(path)
         if fmt == "saved_model"
