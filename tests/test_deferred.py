@@ -258,10 +258,11 @@ def test_results_during_slot_copy_reroutes_batch(monkeypatch):
     loop.run_until_complete(pool.start())
     try:
         async def go():
-            fut1 = await pool.enqueue((4,), batch(1))
+            fut1 = await pool.enqueue((4,), batch(4, seed=1))
             slow_from["t"] = time.perf_counter()
             w1 = pool._active
-            fut2 = await pool.enqueue((4,), batch(2))  # copy spans the retire
+            # copy spans the retire (4 rows: full bucket, matching the slot)
+            fut2 = await pool.enqueue((4,), batch(4, seed=2))
             out1, out2 = await asyncio.wait_for(
                 asyncio.gather(fut1, fut2), timeout=120)
             assert out1["probs"].shape == (4, 3)

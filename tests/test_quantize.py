@@ -2,6 +2,7 @@
 mirroring for tensor parallelism, and the end-to-end serving path."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
@@ -125,6 +126,57 @@ def test_tp_sharded_quantized_bert_runs():
                              "application/json")
     out = rt.fetch(rt.run(bucket, model.assemble([item, item], bucket)))
     assert np.isfinite(out["probs"]).all()
+
+
+def test_int8_matmul_matches_dequant_dense():
+    """int8 x int8 -> int32 with dynamic activation scales tracks the
+    dequantize-then-dense product to quantization tolerance."""
+    from tpuserve.quantize import int8_matmul, quantize_leaf
+
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((4, 96)).astype(np.float32)
+    w = rng.standard_normal((96, 128)).astype(np.float32)
+    q = quantize_leaf(w)
+    ref = x @ (q["q8"].astype(np.float32) * q["q8_scale"])
+    got = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q["q8"]),
+                                 jnp.asarray(q["q8_scale"]), jnp.float32))
+    # int8c adds only activation rounding on top of the weight rounding;
+    # bound the error against the output scale (elementwise relative error
+    # is meaningless where the dot products cancel to ~0).
+    assert np.isfinite(got).all()
+    assert np.abs(got - ref).max() < 0.02 * np.abs(ref).max()
+
+
+def test_int8c_bert_serves_with_bounded_drift():
+    """quantize='int8c' (FFN matmuls on the MXU's int8 path) serves with
+    top-1 agreement and bounded prob drift vs full precision, and the
+    unsupported-family config fails with guidance."""
+    def bert_cfg(**over):
+        base = dict(
+            name="b", family="bert", parallelism="single",
+            batch_buckets=[2], seq_buckets=[16], dtype="float32",
+            num_classes=4, quantize_min_size=256,
+            options={"layers": 2, "d_model": 32, "heads": 2, "d_ff": 64,
+                     "vocab_size": 512},
+        )
+        base.update(over)
+        return ModelConfig(**base)
+
+    def run(cfg):
+        model = build(cfg)
+        rt = build_runtime(model)
+        (bucket,) = rt.executables
+        item = model.host_decode(b'{"text": "int8 compute on the mxu"}',
+                                 "application/json")
+        return rt.fetch(rt.run(bucket, model.assemble([item, item], bucket)))
+
+    out_fp = run(bert_cfg())
+    out_c = run(bert_cfg(quantize="int8c"))
+    assert out_c["indices"][0][0] == out_fp["indices"][0][0]
+    np.testing.assert_allclose(out_c["probs"], out_fp["probs"], atol=3e-2)
+
+    with pytest.raises(ValueError, match="int8c.*not.*supported|weight-only"):
+        build_runtime(build(_toy_cfg(quantize="int8c")))
 
 
 @pytest.mark.slow

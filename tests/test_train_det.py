@@ -137,6 +137,64 @@ def test_finetune_loss_decreases_and_checkpoint_serves(tmp_path):
     assert int(outp["n"][0]) >= 0
 
 
+def _box_iou(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU matrix between (N, 4) and (M, 4) corner boxes (y0, x0, y1, x1)."""
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    y0 = np.maximum(a[:, None, 0], b[None, :, 0])
+    x0 = np.maximum(a[:, None, 1], b[None, :, 1])
+    y1 = np.minimum(a[:, None, 2], b[None, :, 2])
+    x1 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(y1 - y0, 0) * np.maximum(x1 - x0, 0)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
+
+
+@pytest.mark.slow
+def test_trained_detector_finds_boxes_recall():
+    """The fine-tune must produce a detector that FINDS the synthetic
+    rectangles, not just a loss that slopes down (VERDICT r4 weak 5): after
+    training, recall@IoU>=0.5 on a HELD-OUT synthetic batch, measured
+    through the full serving path (device resize -> heads -> decode_boxes ->
+    NMS), must clear a threshold a background-collapsed detector cannot.
+    BASELINE.md records the measured value ("Synthetic detection quality")."""
+    from tpuserve.parallel import make_mesh
+
+    cfg = det_cfg()
+    serving = build(cfg)
+    mesh = make_mesh()
+    tcfg = DetTrainConfig(lr=3e-3, max_boxes=4)
+    params, tx, opt_state = make_det_train_state(serving, mesh, tcfg)
+    step, _ = make_det_train_step(serving, tx, mesh, tcfg)
+
+    bs = int(mesh.shape["data"])
+    for i in range(60):
+        batch = synthetic_det_batch(bs, cfg.wire_size, cfg.image_size,
+                                    serving.det_classes, tcfg.max_boxes,
+                                    seed=i)
+        params, opt_state, _ = step(params, opt_state, batch)
+
+    # Held-out images (seeds never trained on), through the serving forward.
+    fwd = jax.jit(serving.forward)
+    total, found = 0, 0
+    for seed in (1000, 1001):
+        ev = synthetic_det_batch(bs, cfg.wire_size, cfg.image_size,
+                                 serving.det_classes, tcfg.max_boxes,
+                                 seed=seed)
+        out = fwd(params, jnp.asarray(ev["images"]))
+        boxes = np.asarray(out["boxes"]) * cfg.image_size  # [0,1] -> pixels
+        n_det = np.asarray(out["n"])
+        for b in range(bs):
+            gt = ev["boxes"][b][ev["valid"][b]]
+            if not len(gt):
+                continue
+            det = boxes[b, : int(n_det[b])]
+            total += len(gt)
+            if len(det):
+                found += int((_box_iou(gt, det).max(axis=1) >= 0.5).sum())
+    recall = found / total
+    assert recall >= 0.6, f"recall@0.5 = {recall:.2f} ({found}/{total})"
+
+
 @pytest.mark.slow
 def test_finetune_det_cli(tmp_path):
     from tpuserve.cli import main

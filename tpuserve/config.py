@@ -52,10 +52,12 @@ class ModelConfig:
     request_timeout_ms: float = 2000.0
     # Compute dtype for params/activations on device.
     dtype: str = "bfloat16"
-    # Weight-only quantization: "int8" stores large weights as int8 +
-    # per-channel scales and dequantizes inside the compiled forward (halves
-    # HBM weight streaming and upload bytes; see tpuserve.quantize). None =
-    # full compute-dtype weights.
+    # Quantization: "int8" stores large weights as int8 + per-channel scales
+    # and dequantizes inside the compiled forward (halves HBM weight
+    # streaming and upload bytes); "int8c" additionally COMPUTES the
+    # model's opted-in matmul sites int8 x int8 -> int32 on the MXU with
+    # dynamic per-token activation scales (families that name native sites
+    # only — see tpuserve.quantize). None = full compute-dtype weights.
     quantize: str | None = None
     # Float leaves smaller than this stay unquantized (biases, norms).
     quantize_min_size: int = 4096
@@ -73,11 +75,16 @@ class ModelConfig:
     #   (preproc.device_prepare_images_yuv420). Requires wire_size % 16 == 0.
     wire_format: str = "rgb8"
     # Parallelism mode: "sharded" (one executable, batch sharded over the
-    # mesh), "replica" (one executable per device, independent queues), or
-    # "single" (first device only). SURVEY.md §2.1.
+    # mesh), "replica" (one executable per device, independent queues),
+    # "single" (first device only), or "pipeline" (layer stack split into
+    # `pp` GPipe stages over a ("stage",) mesh — families whose depth is a
+    # homogeneous block stack, e.g. BERT; for models too deep/large for one
+    # device's memory). SURVEY.md §2.1.
     parallelism: str = "sharded"
     # Tensor-parallel axis size carved out of the mesh (1 = TP off).
     tp: int = 1
+    # Pipeline stage count for parallelism = "pipeline" (0 = all devices).
+    pp: int = 0
     # Sequence-parallel axis size (1 = SP off). With BERT's
     # options.attention = "ring", activations shard their seq dim over this
     # axis and attention rotates K/V around the ICI ring — long-context

@@ -112,6 +112,21 @@ class ServingModel(abc.ABC):
     def forward(self, params: Any, batch: HostBatch) -> Outputs:
         """Jittable: on-device preproc + network + on-device postproc."""
 
+    def prepare_host_params(self, params: Any) -> Any:
+        """Restructure loaded host params for the serving mode before
+        sharding (runtime calls this between load and device_put). Default
+        identity; the pipeline mode uses it to restack the layer stack into
+        stage-major leaves with a leading ("stage",)-shardable dim."""
+        return params
+
+    def int8c_native_kernel_paths(self) -> list[str]:
+        """Regexes of param paths this model computes in int8 NATIVELY
+        (``quantize = "int8c"``): those kernels stay ``{"q8", "q8_scale"}``
+        in the compiled forward and run int8 x int8 -> int32 on the MXU
+        (tpuserve.quantize.Int8Dense). Empty means the family only supports
+        weight-only "int8" — the runtime rejects "int8c" with guidance."""
+        return []
+
     # -- host-side ----------------------------------------------------------
     @abc.abstractmethod
     def host_decode(self, payload: bytes, content_type: str) -> Any:
@@ -198,9 +213,16 @@ class ServingModel(abc.ABC):
         return [(".*", P())]
 
     def batch_spec(self) -> Any:
-        """PartitionSpec pytree for the batch input (leading dim = data axis)."""
+        """PartitionSpec pytree for the batch input (leading dim = data axis).
+        Pipeline mode's ("stage",) mesh has no data axis: batches replicate
+        and the model microbatches internally."""
+        if self.cfg.parallelism == "pipeline":
+            return P()
         return P("data")
 
     def out_spec(self) -> Any:
-        """PartitionSpec pytree for forward outputs."""
+        """PartitionSpec pytree for forward outputs (replicated under
+        pipeline — the last stage's psum already replicates them)."""
+        if self.cfg.parallelism == "pipeline":
+            return P()
         return P("data")

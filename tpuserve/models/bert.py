@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from tpuserve import quantize as qz
 from tpuserve.config import ModelConfig
 from tpuserve.models.base import ServingModel
 from tpuserve.text import WordPieceTokenizer, synthetic_vocab
@@ -51,6 +52,9 @@ class BertBlock(nn.Module):
     # (tpuserve.ops.moe); expert dims shard on "model" for EP serving.
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # True: FFN matmuls via quantize.Int8Dense (int8 MXU path when the
+    # runtime leaves their kernels quantized — quantize = "int8c").
+    quantize_compute: bool = False
 
     @nn.compact
     def __call__(self, x, mask_bias):
@@ -130,11 +134,17 @@ class BertBlock(nn.Module):
                                 capacity_factor=self.moe_capacity_factor,
                                 dtype=self.dtype, name="moe")(x, token_mask)
         else:
-            h = nn.Dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
+            # Int8Dense == nn.Dense structurally; with quantize="int8c" the
+            # runtime leaves these two kernels {"q8","q8_scale"} and the
+            # FFN matmuls (2/3 of block FLOPs) run int8 on the MXU.
+            dense = (qz.Int8Dense if self.quantize_compute else
+                     lambda features, dtype, name: nn.Dense(
+                         features, dtype=dtype, name=name))
+            h = dense(self.d_ff, dtype=self.dtype, name="mlp_up")(x)
             # Exact (erf) GELU, matching BERT; the tanh approximation drifts
             # ~1e-3 on imported weights.
             h = nn.gelu(h, approximate=False)
-            h = nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
+            h = dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
         return ln("ln_mlp")(x + h)
 
 
@@ -161,6 +171,7 @@ class BertClassifier(nn.Module):
     mesh: Any = None
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    quantize_compute: bool = False
 
     @nn.compact
     def __call__(self, ids, mask):
@@ -176,6 +187,7 @@ class BertClassifier(nn.Module):
                           ln_eps=self.ln_eps, mesh=self.mesh,
                           moe_experts=self.moe_experts,
                           moe_capacity_factor=self.moe_capacity_factor,
+                          quantize_compute=self.quantize_compute,
                           name=f"layer{i}")(x, mask_bias)
         cls = x[:, 0, :]
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=self.dtype, name="pooler")(cls))
@@ -193,6 +205,23 @@ class BertServing(ServingModel):
         # attention='flash' + parallelism='sharded' is supported: bind_mesh
         # routes the kernel through shard_map (GSPMD can't auto-partition a
         # Mosaic call; per-device local execution is the composition).
+        # Pipeline serving (parallelism = "pipeline"): the homogeneous block
+        # stack splits into GPipe stages over a ("stage",) mesh, one stage's
+        # params per device (tpuserve.parallel.pipeline). v1 composes with
+        # dense attention only: flash/ring/ulysses close over meshes or
+        # kernels that would nest shard_maps, and MoE routing would need
+        # expert state inside the stage scan.
+        self.pipeline_capable = True
+        self._stage_mesh = None
+        if cfg.parallelism == "pipeline":
+            if attention != "dense":
+                raise ValueError(
+                    f"parallelism='pipeline' supports options.attention="
+                    f"'dense' only, got {attention!r}")
+            if int(opt.get("moe_experts", 0)):
+                raise ValueError(
+                    "parallelism='pipeline' does not compose with "
+                    "options.moe_experts")
         if attention in ("ring", "ulysses"):
             if cfg.parallelism == "replica":
                 # One shared module can't close over N per-replica meshes;
@@ -256,13 +285,37 @@ class BertServing(ServingModel):
             # expert dim sharded on "model" (expert parallelism).
             moe_experts=moe_experts,
             moe_capacity_factor=float(opt.get("moe_capacity_factor", 1.25)),
+            # "int8c" computes the FFN matmuls int8 x int8 -> int32 on the
+            # MXU (quantize.Int8Dense consumes the still-quantized kernels
+            # the runtime leaves in place — int8c_native_kernel_paths).
+            quantize_compute=cfg.quantize == "int8c",
         )
         self.top_k = min(5, cfg.num_classes)
+
+    def int8c_native_kernel_paths(self) -> list[str]:
+        """The FFN kernels Int8Dense consumes natively under int8c (2/3 of
+        block matmul FLOPs); attention projections stay weight-only. The
+        MoE variant has no mlp kernels (SwitchFFN replaces them), so it
+        returns [] and the runtime rejects int8c with guidance rather than
+        silently degrading to weight-only."""
+        if self.module.moe_experts:
+            return []
+        return [r"mlp_(up|down)/kernel$"]
 
     def bind_mesh(self, mesh: Any) -> None:
         """Mesh-aware attention closes over the serving mesh: ring/ulysses
         always; flash only in sharded mode (it shard_maps over the mesh —
-        replica/single modes call the kernel directly)."""
+        replica/single modes call the kernel directly). Pipeline mode stores
+        the ("stage",) mesh for _pipeline_forward and validates the layer
+        split here (the stage count is only known once the mesh exists)."""
+        if self.cfg.parallelism == "pipeline":
+            s = int(mesh.shape["stage"])
+            if self.module.layers % s:
+                raise ValueError(
+                    f"pipeline: layers={self.module.layers} must split "
+                    f"evenly over {s} stages; adjust options.layers or pp")
+            self._stage_mesh = mesh
+            return
         if self.module.attention_impl in ("ring", "ulysses") or (
                 self.module.attention_impl == "flash"
                 and self.cfg.parallelism == "sharded"):
@@ -396,10 +449,95 @@ class BertServing(ServingModel):
     # -- device side ---------------------------------------------------------
     def forward(self, params: Any, batch: Any) -> dict:
         ids, mask = batch
-        logits = self.module.apply(params, ids, mask)
+        if self.cfg.parallelism == "pipeline":
+            logits = self._pipeline_logits(params, ids, mask)
+        else:
+            logits = self.module.apply(params, ids, mask)
         probs = jax.nn.softmax(logits, axis=-1)
         top_p, top_i = jax.lax.top_k(probs, self.top_k)
         return {"probs": top_p, "indices": top_i}
+
+    # -- pipeline serving (parallelism = "pipeline") -------------------------
+    def prepare_host_params(self, params: Any) -> Any:
+        """Restack the flax tree stage-major for GPipe serving: layer i's
+        block params land in stage i // (L/S), slot i %% (L/S), stacked so
+        every ``staged/blk{j}`` leaf has a leading (S, ...) dim sharded on
+        the "stage" axis — each device materializes 1/S of the trunk, the
+        memory point of PP. Embed/pooler/classifier stay replicated under
+        ``unstaged``. Inverse mapping keeps checkpoints portable: any
+        weights loadable in single mode load identically here."""
+        if self.cfg.parallelism != "pipeline":
+            return params
+        if self._stage_mesh is None:
+            raise RuntimeError("bind_mesh must run before prepare_host_params")
+        s = int(self._stage_mesh.shape["stage"])
+        p = dict(params["params"])
+        per = self.module.layers // s
+        layers = [p.pop(f"layer{i}") for i in range(self.module.layers)]
+        staged = {
+            f"blk{j}": jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs),
+                *[layers[st * per + j] for st in range(s)])
+            for j in range(per)
+        }
+        return {"unstaged": p, "staged": staged}
+
+    def _pp_micro(self, b: int, s: int) -> int:
+        """Microbatch count: options.pp_micro, else the largest divisor of
+        the bucket batch <= 2*S (enough microbatches to amortize the
+        (S-1)-tick pipeline bubble without shrinking the per-tick matmul
+        below MXU-filling sizes)."""
+        override = int(self.cfg.options.get("pp_micro", 0))
+        if override:
+            if b % override:
+                raise ValueError(
+                    f"options.pp_micro={override} must divide every batch "
+                    f"bucket; {b} is not divisible")
+            return override
+        return max(d for d in range(1, b + 1) if b % d == 0 and d <= 2 * s)
+
+    def _pipeline_logits(self, params: Any, ids, mask):
+        """BertClassifier.__call__ restructured as embed (replicated) ->
+        GPipe trunk (pipeline_forward over the stage mesh) -> head
+        (replicated). The padding mask rides the microbatch stream as one
+        extra channel so stage_fn stays shape-preserving."""
+        from tpuserve.parallel.pipeline import pipeline_forward
+
+        mod = self.module
+        mesh = self._stage_mesh
+        s_axis = int(mesh.shape["stage"])
+        per = mod.layers // s_axis
+        dt = mod.dtype
+        u = params["unstaged"]
+        b, seq = ids.shape
+
+        x = nn.Embed(mod.vocab_size, mod.d_model, dtype=dt).apply(
+            {"params": u["embed"]}, ids)
+        x = x + u["pos_embed"][None, :seq, :].astype(dt)
+        x = nn.LayerNorm(epsilon=mod.ln_eps, dtype=dt).apply(
+            {"params": u["ln_embed"]}, x)
+
+        block = BertBlock(mod.heads, mod.d_ff, dtype=dt,
+                          attention_impl="dense", ln_eps=mod.ln_eps)
+
+        def stage_fn(sp, x_aug):
+            h, maskc = x_aug[..., : mod.d_model], x_aug[..., mod.d_model]
+            bias = (1.0 - maskc.astype(jnp.float32))[:, None, None, :] * -1e9
+            for j in range(per):
+                h = block.apply({"params": sp[f"blk{j}"]}, h, bias)
+            return jnp.concatenate([h, maskc[..., None]], axis=-1)
+
+        x_aug = jnp.concatenate([x, mask.astype(dt)[..., None]], axis=-1)
+        n_micro = self._pp_micro(b, s_axis)
+        xs = x_aug.reshape(n_micro, b // n_micro, seq, mod.d_model + 1)
+        ys = pipeline_forward(stage_fn, params["staged"], xs, mesh)
+        x = ys.reshape(b, seq, mod.d_model + 1)[..., : mod.d_model]
+
+        cls = x[:, 0, :]
+        pooled = jnp.tanh(nn.Dense(mod.d_model, dtype=dt).apply(
+            {"params": u["pooler"]}, cls))
+        return nn.Dense(mod.num_classes, dtype=jnp.float32).apply(
+            {"params": u["classifier"]}, pooled)
 
     # -- host side -----------------------------------------------------------
     def host_decode(self, payload: bytes, content_type: str) -> np.ndarray:
@@ -458,6 +596,10 @@ class BertServing(ServingModel):
 
     # -- parallelism ---------------------------------------------------------
     def partition_rules(self):
+        if self.cfg.parallelism == "pipeline":
+            # Stage-stacked trunk on the ("stage",) axis; embed/head
+            # replicated (prepare_host_params produced this layout).
+            return [(r"^staged/", P("stage")), (r".*", P())]
         if self.cfg.tp <= 1:
             return [(".*", P())]
         return [

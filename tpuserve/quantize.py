@@ -128,3 +128,95 @@ def dequantize_tree(params: Any, dtype: Any) -> Any:
         params,
         is_leaf=is_quantized,
     )
+
+
+# -- int8 COMPUTE path (quantize = "int8c") -----------------------------------
+#
+# Weight-only int8 halves HBM traffic but the MXU still multiplies in bf16.
+# v5e's int8 matmul peak is ~2x its bf16 peak (394 vs 197 TOP/s), so for
+# matmul-bound serving shapes the second lever is computing IN int8:
+# dynamic per-token absmax quantization of the activations, an
+# int8 x int8 -> int32 ``lax.dot_general`` on the MXU, and a per-channel
+# f32 rescale folded into the output. Models opt sites in by building with
+# ``Int8Dense`` (same param paths as ``nn.Dense``) and naming those kernel
+# paths in ``int8c_native_kernel_paths()``; the runtime then leaves exactly
+# those leaves quantized in the compiled forward and dequantizes the rest
+# as in plain "int8" mode. Accuracy is gated the same way as storage int8:
+# tests/test_quantize.py drift bounds + the imported-weight parity test.
+
+import re  # noqa: E402  (stdlib; used by the int8c path filter below)
+
+import flax.linen as nn  # noqa: E402
+
+
+def int8_matmul(x: jax.Array, wq: jax.Array, w_scale: jax.Array,
+                out_dtype: Any) -> jax.Array:
+    """``x @ dequant(wq)`` computed as int8 x int8 -> int32 on the MXU.
+
+    x: (..., K) float; wq: (K, N) int8; w_scale: (1, N) or (N,) f32 (the
+    per-channel scale quantize_leaf stores). The activation scale is
+    dynamic per token (absmax over the K axis), so no calibration pass is
+    needed and padded lanes cannot skew other rows' scales.
+    """
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    s_x = jnp.maximum(amax, 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / s_x),
+                  -127, 127).astype(jnp.int8)
+    y = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (y.astype(jnp.float32) * s_x
+            * w_scale.reshape(-1).astype(jnp.float32)).astype(out_dtype)
+
+
+class Int8Dense(nn.Module):
+    """Drop-in ``nn.Dense`` whose kernel may arrive int8-quantized.
+
+    Param paths and init are identical to ``nn.Dense`` (``kernel`` f32
+    lecun-normal, ``bias`` f32 zeros), so import mappers, partition rules,
+    and orbax checkpoints see no structural difference. When the runtime
+    hands the compiled forward a tree whose ``kernel`` leaf is the
+    ``{"q8", "q8_scale"}`` dict (quantize = "int8c"), the matmul runs
+    int8 x int8 -> int32 (``int8_matmul``); a plain float kernel takes the
+    ordinary dense path, which keeps CPU tests, random-init serving, and
+    non-quantized checkpoints working unchanged.
+    """
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        if is_quantized(kernel):
+            y = int8_matmul(x, kernel[QKEY], kernel[SKEY], self.dtype)
+        else:
+            y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        return y + bias.astype(self.dtype)
+
+
+def dequantize_tree_except(params: Any, dtype: Any,
+                           keep: list[str]) -> Any:
+    """Dequantize every quantized leaf EXCEPT those whose '/'-joined path
+    matches one of the ``keep`` regexes — those stay {"q8", "q8_scale"} for
+    modules (Int8Dense) that compute in int8 natively."""
+    from tpuserve.parallel.partition import _join_path
+
+    pats = [re.compile(p) for p in keep]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=is_quantized)
+    out = []
+    for path, leaf in flat:
+        if is_quantized(leaf):
+            name = _join_path(path, "/")
+            if any(p.search(name) for p in pats):
+                out.append(leaf)
+            else:
+                out.append((leaf[QKEY].astype(dtype)
+                            * leaf[SKEY].astype(dtype)))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)

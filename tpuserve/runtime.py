@@ -76,16 +76,41 @@ class ModelRuntime:
         self.model = model
         self.cfg: ModelConfig = model.cfg
         self.mode = self.cfg.parallelism
-        if self.mode not in ("sharded", "replica", "single"):
+        if self.mode not in ("sharded", "replica", "single", "pipeline"):
             raise ValueError(f"unknown parallelism mode {self.mode!r}")
-        if self.cfg.quantize not in (None, "int8"):
+        if self.cfg.quantize not in (None, "int8", "int8c"):
             raise ValueError(f"unknown quantize mode {self.cfg.quantize!r}")
+        if (self.cfg.quantize == "int8c"
+                and not model.int8c_native_kernel_paths()):
+            raise ValueError(
+                f"{model.name}: quantize='int8c' (int8 COMPUTE) is not "
+                f"supported by family {self.cfg.family!r} — it names no "
+                "int8-native kernel sites; use quantize='int8' "
+                "(weight-only) instead")
 
         if self.mode == "replica":
             # One 1-device mesh per device; params replicated per device.
             self.meshes = [make_mesh(MeshPlan(), devices=[d]) for d in jax.devices()]
         elif self.mode == "single":
             self.meshes = [make_mesh(MeshPlan(), devices=[jax.devices()[0]])]
+        elif self.mode == "pipeline":
+            # GPipe stages over a ("stage",) mesh: each device holds 1/S of
+            # the layer stack's params (tpuserve.parallel.pipeline). The
+            # model pipelines its own depth, so it must opt in.
+            if not getattr(model, "pipeline_capable", False):
+                raise ValueError(
+                    f"{model.name}: parallelism='pipeline' needs a family "
+                    f"with a homogeneous block stack; {self.cfg.family!r} "
+                    "does not support it (BERT does) — use 'sharded', "
+                    "'replica', or 'single'")
+            if self.cfg.quantize:
+                raise ValueError(
+                    "parallelism='pipeline' does not compose with quantize "
+                    "modes yet; drop one of the two")
+            from tpuserve.parallel.pipeline import make_stage_mesh
+
+            n = self.cfg.pp or len(jax.devices())
+            self.meshes = [make_stage_mesh(n)]
         else:
             self.meshes = [mesh if mesh is not None
                            else make_mesh(MeshPlan(tp=self.cfg.tp, sp=self.cfg.sp))]
@@ -120,7 +145,8 @@ class ModelRuntime:
         # ops; (b) on the tunneled dev TPU, reading back accelerator-side
         # buffers flips the relay into a ~30 MB/s synchronous-transfer mode,
         # so param init must never touch the accelerator.
-        self.params_per_mesh = self._shard_onto_meshes(self._load_host_params())
+        self.params_per_mesh = self._shard_onto_meshes(
+            self.model.prepare_host_params(self._load_host_params()))
 
     def _load_host_params(self) -> Any:
         try:
@@ -150,11 +176,11 @@ class ModelRuntime:
 
         rules = self.model.partition_rules()
         pre_quantized = qz.has_quantized_leaves(params)
-        if pre_quantized and self.cfg.quantize != "int8":
+        if pre_quantized and self.cfg.quantize not in ("int8", "int8c"):
             raise ValueError(
                 f"{self.model.name}: loaded weights are int8-quantized but "
                 "quantize is not set; set quantize = \"int8\"")
-        if self.cfg.quantize == "int8":
+        if self.cfg.quantize in ("int8", "int8c"):
             # Quantize first (idempotent over pre-quantized checkpoints),
             # then derive specs from the tree's actual quantization state —
             # rule regexes see the original weight paths, scale specs derive
@@ -179,6 +205,16 @@ class ModelRuntime:
             dtype = jnp.dtype(self.cfg.dtype)
             return lambda p, batch: self.model.forward(
                 qz.dequantize_tree(p, dtype), batch)
+        if self.cfg.quantize == "int8c":
+            # int8 COMPUTE: kernels the model consumes natively (Int8Dense
+            # sites) stay {"q8", "q8_scale"} and hit the MXU's int8 path;
+            # everything else dequantizes as in weight-only mode.
+            from tpuserve import quantize as qz
+
+            dtype = jnp.dtype(self.cfg.dtype)
+            keep = self.model.int8c_native_kernel_paths()
+            return lambda p, batch: self.model.forward(
+                qz.dequantize_tree_except(p, dtype, keep), batch)
         return self.model.forward
 
     def compile_all(self, pool: cf.ThreadPoolExecutor | None = None) -> None:

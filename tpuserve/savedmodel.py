@@ -123,10 +123,11 @@ def load_orbax(path: str, model) -> Any:
         restored = ckptr.restore(apath, target)
 
     if qz.has_quantized_leaves(restored) \
-            and getattr(model.cfg, "quantize", None) != "int8":
+            and getattr(model.cfg, "quantize", None) not in ("int8", "int8c"):
         raise ValueError(
             f"checkpoint at {path!r} holds int8-quantized weights; set "
-            "quantize = \"int8\" on the model to serve it")
+            "quantize = \"int8\" (weight-only) or \"int8c\" (int8 compute) "
+            "on the model to serve it")
 
     raw = jax.eval_shape(model.init_params, jax.random.key(0))
     shape_of = lambda x: (tuple(x[qz.QKEY].shape) if qz.is_quantized(x)  # noqa: E731
