@@ -133,6 +133,19 @@ def run_family(name: str) -> int:
         # Applies to every family this invocation runs — stated in the
         # header and the result line so rows can't be mistaken for bf16.
         fam["model"]["quantize"] = quantize
+    # Chip-level row first (fresh subprocess, device-resident chained loop,
+    # XLA-counted FLOPs -> MFU): the "is it fast, not just correct" axis
+    # the wire-bound HTTP row cannot answer (VERDICT r4 missing 1).
+    # BENCHC_CHIP=0 skips it (e.g. when only the host path is under test).
+    chip = {}
+    if os.environ.get("BENCHC_CHIP", "1") != "0":
+        from tpuserve.bench.probes import measure_chip_img_s
+
+        chip = measure_chip_img_s(
+            family=name,
+            mcfg_extra={"quantize": quantize} if quantize else None)
+        print(f"# {name}: chip probe {chip}", file=sys.stderr)
+
     port = int(os.environ.get("BENCH_PORT", 18441))
     cfg = ServerConfig(
         host="127.0.0.1", port=port, decode_inline=True, startup_canary=False,
@@ -167,6 +180,17 @@ def run_family(name: str) -> int:
                     f"@{fam['model'].get('wire_size', '-')}"
                     if fam["payload"] == "jpeg" else "json",
             **res}
+    if chip and "error" not in chip:
+        line.update({
+            "chip_items_s": chip.get("img_s"),
+            "chip_ms_per_batch": chip.get("ms_per_batch"),
+            "chip_bucket": chip.get("bucket"),
+            "chip_gflops_per_item": chip.get("gflops_per_item"),
+            "chip_tflops_s": chip.get("achieved_tflops_s"),
+            "chip_mfu_pct": chip.get("mfu_pct"),
+        })
+    elif chip:
+        line["chip_error"] = chip["error"]
     print(json.dumps(line))
     return 0 if res.get("n_ok", 0) > 0 else 1
 
