@@ -71,11 +71,18 @@ FAMILIES: dict[str, dict] = {
                    request_timeout_ms=120_000.0),
         payload="jpeg", verb="detect", concurrency=24, duration=20.0,
     ),
+    # Measured shape (BASELINE.md "SD 1.5 chip profile", 2026-07-30): CFG
+    # batching b=1 -> 4 cuts per-image device cost 617 -> 457 ms (the MXU
+    # fills at 8 CFG lanes), and concurrency 8 keeps the pipelined
+    # dispatcher's next batch assembled while the current one denoises —
+    # the r4 shape (buckets [1], concurrency 2) left the device idle
+    # between readbacks. unet_attention stays dense: the flash variant
+    # measured 2.4-2.8x SLOWER at SD head dims (same table).
     "sd15": dict(
-        model=dict(name="sd15", family="sd15", batch_buckets=[1],
-                   deadline_ms=5.0, dtype="bfloat16", image_size=512,
+        model=dict(name="sd15", family="sd15", batch_buckets=[1, 2, 4],
+                   deadline_ms=150.0, dtype="bfloat16", image_size=512,
                    request_timeout_ms=600_000.0, options={"steps": 20}),
-        payload="prompt", verb="generate", concurrency=2, duration=120.0,
+        payload="prompt", verb="generate", concurrency=8, duration=120.0,
         warmup=0.0,
     ),
 }

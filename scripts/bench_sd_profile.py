@@ -24,7 +24,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -33,33 +32,9 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+from tpuserve.bench.probes import chained_rate_ms as rate_ms  # noqa: E402
 from tpuserve.config import ModelConfig  # noqa: E402
 from tpuserve.models import build  # noqa: E402
-
-
-def rate_ms(f, inputs, iters: int) -> float:
-    """ms per call of f(*inputs) via a dependency-chained fori loop (the
-    only honest timing on the tunneled TPU — see tpuserve.bench.probes)."""
-
-    @jax.jit
-    def many(inputs):
-        def body(i, carry):
-            inp, acc = carry
-            out = f(*inp)
-            s = jax.tree_util.tree_leaves(out)[0].reshape(-1)[0]
-            s = s.astype(jnp.float32)
-            leaves, td = jax.tree_util.tree_flatten(inp)
-            leaves[-1] = leaves[-1] + (s * 0).astype(leaves[-1].dtype)
-            return (jax.tree_util.tree_unflatten(td, leaves), acc + s)
-
-        _, acc = jax.lax.fori_loop(0, iters, body, (inputs, jnp.float32(0)))
-        return acc
-
-    c = many.lower(inputs).compile()
-    float(c(inputs))  # warm
-    t0 = time.perf_counter()
-    float(c(inputs))
-    return (time.perf_counter() - t0) / iters * 1e3
 
 
 def main() -> int:

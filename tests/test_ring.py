@@ -90,10 +90,12 @@ def test_train_step_with_ring_attention():
 def test_long_context_serving_2048():
     """Long-context serving end-to-end: a (batch, 2048) bucket with ring
     attention over sp=4, the whole-path proof that sequence parallelism
-    extends serving past the BERT-512 regime. head_dim 64 makes the ring's
-    auto local_impl run every per-device block through the Pallas flash
-    kernel (512-row local blocks, lane-aligned head_dim) — the flagship
-    composition: SP ring over ICI, fused kernel inside each device."""
+    extends serving past the BERT-512 regime. At this size the ring's
+    memory-derived auto local_impl picks DENSE per-device math (the 16 MB
+    local score tile is far below the flash threshold, and dense measured
+    faster on v5e — BASELINE.md "Flash vs dense"); the flash-under-ring
+    composition is separately proven by the explicit local_impl='flash'
+    parity tests in test_flash_attention.py."""
     from tpuserve.config import ModelConfig
     from tpuserve.models import build
     from tpuserve.runtime import build_runtime

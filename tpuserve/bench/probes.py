@@ -182,6 +182,39 @@ CHIP_PROBE_SRC = textwrap.dedent("""
     }))
 """)
 
+def chained_rate_ms(f, inputs, iters: int) -> float:
+    """ms per call of ``f(*inputs)`` via a dependency-chained fori loop —
+    the in-process twin of CHIP_PROBE_SRC's timing core (that template must
+    stay self-contained for its fresh-subprocess discipline; any timing-
+    method fix must land in BOTH — this module's one-source-of-truth rule).
+    Used by scripts/bench_sd_profile.py for component-level splits where
+    one process times several functions against shared params."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def many(inputs):
+        def body(i, carry):
+            inp, acc = carry
+            out = f(*inp)
+            s = jax.tree_util.tree_leaves(out)[0].reshape(-1)[0]
+            s = s.astype(jnp.float32)
+            leaves, td = jax.tree_util.tree_flatten(inp)
+            leaves[-1] = leaves[-1] + (s * 0).astype(leaves[-1].dtype)
+            return (jax.tree_util.tree_unflatten(td, leaves), acc + s)
+
+        _, acc = jax.lax.fori_loop(0, iters, body, (inputs, jnp.float32(0)))
+        return acc
+
+    import time as _time
+
+    c = many.lower(inputs).compile()
+    float(c(inputs))  # warm
+    t0 = _time.perf_counter()
+    float(c(inputs))
+    return (_time.perf_counter() - t0) / iters * 1e3
+
+
 # Per-family probe presets: serving-shaped bucket + model options. `family`
 # maps a preset name to the registry family when they differ (bert-moe).
 CHIP_PROBE_FAMILIES: dict[str, dict] = {

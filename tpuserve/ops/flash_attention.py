@@ -1,15 +1,22 @@
 """Fused blockwise (flash) attention as a Pallas TPU kernel (SURVEY.md §7 M8).
 
-Why a hand kernel here and nowhere else: attention is the one serving op
-where XLA's fusion genuinely leaves HBM bandwidth on the table — dense
-attention materializes the (Sq, Sk) score matrix to HBM twice (scores out,
-softmax back in). This kernel keeps the whole online-softmax recurrence in
-VMEM: for each query tile, K/V stream through the MXU in ``block_k`` tiles
-while the running max ``m``, normalizer ``l``, and f32 accumulator live in
-VMEM scratch — O(S) memory instead of O(S^2), one HBM write per output
-tile. It is the single-device realization of the same recurrence
+Why a hand kernel here and nowhere else: **memory, not speed.** Dense
+attention materializes the (Sq, Sk) score matrix — O(S^2) f32 per
+(batch, head) — which caps the sequence length a device can run at all.
+This kernel keeps the whole online-softmax recurrence in VMEM: for each
+query tile, K/V stream through the MXU in ``block_k`` tiles while the
+running max ``m``, normalizer ``l``, and f32 accumulator live in VMEM
+scratch — O(S) memory, one HBM write per output tile. It is the
+single-device realization of the same recurrence
 ``tpuserve.ops.ring_attention`` runs *across* chips (there the blocks arrive
 over ICI via ppermute; here they arrive from HBM via the BlockSpec pipeline).
+
+On raw speed the r5 measurement is unambiguous (BASELINE.md "Flash vs
+dense"): XLA's dense path is FASTER at every judged serving shape on v5e
+(this kernel = 0.45-0.70x), so serving defaults everywhere are dense and
+``ring/ulysses local_impl="auto"`` switches here only when the dense score
+tile would blow the HBM budget. The earlier "the kernel wins when head_dim
+is lane-aligned" claim was measured false and is retracted.
 
 Kernel shape: grid = (B*H, Sq/block_q, Sk/block_k). The TPU grid executes
 the innermost dimension sequentially, so the k-block axis lives in the GRID
@@ -30,11 +37,15 @@ CPU mesh and compiled for real on TPU (``interpret=None`` auto-detects from
 the effective default device, honoring ``jax.default_device(cpu)`` blocks
 like the runtime's CPU-pinned param init).
 
-When to use: measured on v5e, the kernel wins when head_dim is
-lane-aligned (64/128/160+); at SD-UNet-style head dims 40/80 the padded
-lanes waste the MXU and XLA's dense einsum is faster — which is why the
-SD 1.5 UNet keeps dense attention and BERT (head_dim 64) exposes
-``options.attention = "flash"``.
+When to use — MEASURED, see BASELINE.md:
+- "SD 1.5 chip profile" (2026-07-30, v5e): at SD-UNet head dims 40/80 the
+  zero-padded lanes waste 37-50% of the MXU and the kernel runs the UNet
+  step 2.4-2.8x SLOWER than XLA's dense einsum — the SD 1.5 UNet
+  therefore defaults to dense (``options.unet_attention = "flash"`` is
+  opt-in, parity-tested, and exists for lane-aligned custom variants).
+- "Flash vs dense, chip level" (same date): BERT-family numbers
+  (head_dim 64, lane-aligned) per seq length; ``ring_attention``'s
+  ``local_impl="auto"`` thresholds cite that table.
 """
 
 from __future__ import annotations

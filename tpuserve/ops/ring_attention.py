@@ -31,6 +31,35 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+# Dense-vs-flash local-math decision threshold: the per-device f32 score
+# tile (x2 for the softmax temp XLA keeps alive). Above ~2 GiB dense
+# attention starts evicting everything else from a 16 GiB v5e; below it,
+# dense is simply FASTER (measured 1.4-2.2x at every serving shape —
+# BASELINE.md "Flash vs dense, chip level", 2026-07-30).
+DENSE_SCORE_BYTES_MAX = 2 << 30
+
+
+def auto_local_impl(b_loc: int, h_loc: int, s_loc: int, d: int) -> str:
+    """Memory-derived per-device attention impl choice (pure; unit-tested
+    directly in tests/test_flash_attention.py because no CPU-testable
+    shape can cross the threshold for real)."""
+    kernel_ok = d % 64 == 0 and s_loc % 8 == 0
+    dense_score_bytes = 2 * 4 * b_loc * h_loc * s_loc * s_loc
+    return ("flash" if kernel_ok and dense_score_bytes > DENSE_SCORE_BYTES_MAX
+            else "dense")
+
+
+def _spec_axis_size(mesh: Mesh, entry) -> int:
+    """Product of mesh-axis sizes a PartitionSpec entry shards over."""
+    if entry is None:
+        return 1
+    axes = entry if isinstance(entry, (tuple, list)) else [entry]
+    n = 1
+    for a in axes:
+        n *= int(mesh.shape[a])
+    return n
+
+
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     bias: jax.Array | None = None) -> jax.Array:
     """Reference single-device attention, (B, S, H, D) layout.
@@ -128,9 +157,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         heads tensor-parallel through the ring (position 1 must be
         ``axis_name``). Default shards only the seq dim.
       local_impl: per-device block math — "dense" (einsum, materializes the
-        local score tile), "flash" (fused Pallas kernel), or "auto" (flash
-        when shapes are kernel-friendly: lane-aligned head_dim, 8-row-
-        alignable local seq blocks).
+        local score tile), "flash" (fused Pallas kernel), or "auto".
+        "auto" is MEMORY-derived, not speed-derived: the v5e measurement
+        (BASELINE.md "Flash vs dense, chip level", 2026-07-30) shows dense
+        FASTER at every serving shape (flash = 0.45-0.70x), so auto picks
+        dense whenever the local score tile plausibly fits HBM and only
+        switches to flash when the O(s_loc^2) dense scores grow into the
+        GB range — the regime flash exists for (it also needs the usual
+        kernel alignment: head_dim % 64 == 0, s_loc % 8 == 0).
 
     Returns (batch, seq, heads, head_dim), sharded like q.
     """
@@ -141,9 +175,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(f"spec {qkv_spec} must put {axis_name!r} on the seq dim")
     if local_impl == "auto":
         n = int(mesh.shape[axis_name])
-        s_loc, d = q.shape[1] // n, q.shape[-1]
-        local_impl = ("flash"
-                      if d % 64 == 0 and s_loc % 8 == 0 else "dense")
+        b, _, h, d = q.shape
+        # The decision models the PER-DEVICE tile: divide batch and heads
+        # by whatever mesh axes the spec shards them over (r5 review:
+        # using global shapes overestimated by dp*tp and flipped sharded
+        # serving onto the measured-slower kernel).
+        b_loc = b // _spec_axis_size(mesh, qkv_spec[0])
+        h_loc = h // _spec_axis_size(mesh, qkv_spec[2])
+        local_impl = auto_local_impl(b_loc, h_loc, q.shape[1] // n, d)
     elif local_impl not in ("dense", "flash"):
         raise ValueError(f"unknown local_impl {local_impl!r}")
     bias_spec = P(qkv_spec[0], axis_name)

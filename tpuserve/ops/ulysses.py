@@ -98,8 +98,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"ulysses needs local heads ({h}) divisible by the {axis_name!r} "
             f"axis size ({n}); use ring_attention for this shape")
     if local_impl == "auto":
-        d, s = q.shape[-1], q.shape[1]
-        local_impl = "flash" if d % 64 == 0 and s % 8 == 0 else "dense"
+        # Memory-derived, shared with ring_attention (see its docstring and
+        # BASELINE.md "Flash vs dense, chip level": dense measured FASTER
+        # at every serving shape on v5e; flash is for when the full-seq
+        # dense scores stop fitting). Ulysses' local attention sees the
+        # FULL sequence with h/n heads per device; batch divides over
+        # whatever the spec shards it on (h already divided above).
+        from tpuserve.ops.ring_attention import _spec_axis_size, auto_local_impl
+
+        b_loc = q.shape[0] // _spec_axis_size(mesh, qkv_spec[0])
+        local_impl = auto_local_impl(b_loc, h // n, q.shape[1], q.shape[-1])
     elif local_impl not in ("dense", "flash"):
         raise ValueError(f"unknown local_impl {local_impl!r}")
     bias_spec = P(qkv_spec[0], axis_name)
