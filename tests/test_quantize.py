@@ -179,6 +179,59 @@ def test_int8c_bert_serves_with_bounded_drift():
         build_runtime(build(_toy_cfg(quantize="int8c")))
 
 
+def test_int8c_resnet_serves_with_bounded_drift():
+    """ResNet-50's int8c site (bottleneck 1x1 convs via Int8Conv1x1,
+    including the strided v1-downsample and projection variants): top-1
+    agreement and bounded prob drift vs full precision through the
+    production runtime."""
+    def rn_cfg(**over):
+        base = dict(
+            name="rn", family="resnet50", parallelism="single",
+            batch_buckets=[2], dtype="float32", num_classes=10,
+            image_size=32, wire_size=32, quantize_min_size=256,
+            options={"v1_downsample": True},
+        )
+        base.update(over)
+        return ModelConfig(**base)
+
+    img = np.random.default_rng(5).integers(0, 255, (32, 32, 3), np.uint8)
+
+    def run(cfg):
+        model = build(cfg)
+        rt = build_runtime(model)
+        (bucket,) = rt.executables
+        return rt.fetch(rt.run(bucket, model.assemble([img, img], bucket)))
+
+    out_fp = run(rn_cfg())
+    out_c = run(rn_cfg(quantize="int8c"))
+    assert out_c["indices"][0][0] == out_fp["indices"][0][0]
+    np.testing.assert_allclose(out_c["probs"], out_fp["probs"], atol=3e-2)
+
+
+def test_int8_conv1x1_matches_dense_conv():
+    """Int8Conv1x1's strided matmul == nn.Conv 1x1 with the same
+    (dequantized) kernel, on both stride variants."""
+    import flax.linen as nn
+
+    from tpuserve.quantize import Int8Conv1x1, quantize_leaf
+
+    rng = np.random.default_rng(9)
+    x = jnp.asarray(rng.standard_normal((2, 8, 8, 16)).astype(np.float32))
+    w = rng.standard_normal((1, 1, 16, 24)).astype(np.float32)
+    for strides in ((1, 1), (2, 2)):
+        conv = nn.Conv(24, (1, 1), strides=strides, use_bias=False,
+                       dtype=jnp.float32)
+        q = quantize_leaf(w)
+        wdq = q["q8"].astype(np.float32) * q["q8_scale"]
+        ref = conv.apply({"params": {"kernel": jnp.asarray(wdq)}}, x)
+        mod = Int8Conv1x1(24, strides=strides, dtype=jnp.float32)
+        got = mod.apply({"params": {"kernel": {"q8": jnp.asarray(q["q8"]),
+                                               "q8_scale": jnp.asarray(q["q8_scale"])}}}, x)
+        assert got.shape == ref.shape
+        assert np.abs(np.asarray(got) - np.asarray(ref)).max() \
+            < 0.02 * np.abs(np.asarray(ref)).max()
+
+
 @pytest.mark.slow
 def test_recycle_mode_with_int8_weights():
     """Regression: the deferred worker must compile the dequant-wrapped

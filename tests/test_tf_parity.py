@@ -206,6 +206,41 @@ def test_bert_tf_and_jax_logits_agree(bert_savedmodel):
     assert (y_jax.argmax(-1) == y_tf.argmax(-1)).all()
 
 
+def test_int8c_accuracy_on_imported_bert(bert_savedmodel):
+    """Extend the imported-weight accuracy gate to the int8 COMPUTE path
+    (VERDICT r4 next 5): the TF-imported BERT served with quantize='int8c'
+    (FFN matmuls int8 x int8 -> int32 on the MXU, dynamic activation
+    scales) must keep top-1 identical to the full-precision serving path
+    with bounded prob drift. Same import, layouts, and runtime wiring as
+    production — only the weights are randomized (no artifacts in this
+    container)."""
+    from tpuserve.runtime import build_runtime
+
+    _, path = bert_savedmodel
+
+    def serve(quantize):
+        cfg = bert_cfg(weights=path)
+        cfg.parallelism = "single"
+        cfg.batch_buckets = [2]
+        cfg.seq_buckets = [16]
+        cfg.quantize = quantize
+        cfg.quantize_min_size = 256
+        model = build(cfg)
+        rt = build_runtime(model)
+        (bucket,) = rt.executables
+        items = [model.host_decode(b'{"text": "imported weights int8c"}',
+                                   "application/json")] * 2
+        return rt.fetch(rt.run(bucket, model.assemble(items, bucket)))
+
+    out_fp = serve(None)
+    out_c = serve("int8c")
+    assert (out_c["indices"][0][0] == out_fp["indices"][0][0]).all()
+    drift = float(np.abs(out_c["probs"] - out_fp["probs"]).max())
+    print(f"# int8c-vs-f32 on imported BERT: top-1 equal, "
+          f"max prob drift {drift:.4f}")
+    assert drift < 3e-2
+
+
 def test_bert_rejects_vocab_mismatch(bert_savedmodel):
     """A checkpoint whose vocab differs from the serving tokenizer's must
     fail at load time, not serve silently-wrong logits."""

@@ -27,6 +27,7 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from tpuserve import quantize as qz
 from tpuserve.config import ModelConfig
 from tpuserve.models.vision import ImageClassifierServing
 
@@ -38,24 +39,34 @@ class Bottleneck(nn.Module):
     v1_downsample: bool = False
     bn_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # True: the three 1x1 convs run via quantize.Int8Conv1x1 (int8 MXU path
+    # when the runtime leaves their kernels quantized — quantize = "int8c";
+    # ~45% of block FLOPs). The 3x3 stays a regular conv either way.
+    quantize_compute: bool = False
 
     @nn.compact
     def __call__(self, x):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        if self.quantize_compute:
+            conv1x1 = lambda f, strides=(1, 1), name=None: qz.Int8Conv1x1(  # noqa: E731
+                f, strides=strides, dtype=self.dtype, name=name)
+        else:
+            conv1x1 = lambda f, strides=(1, 1), name=None: conv(  # noqa: E731
+                f, (1, 1), strides=strides, name=name)
         bn = partial(nn.BatchNorm, use_running_average=True, momentum=0.9,
                      epsilon=self.bn_eps, dtype=self.dtype)
         s = (self.strides, self.strides)
         s1, s2 = (s, (1, 1)) if self.v1_downsample else ((1, 1), s)
         residual = x
-        y = conv(self.features, (1, 1), strides=s1, name="conv1")(x)
+        y = conv1x1(self.features, strides=s1, name="conv1")(x)
         y = nn.relu(bn(name="bn1")(y))
         y = conv(self.features, (3, 3), strides=s2, name="conv2")(y)
         y = nn.relu(bn(name="bn2")(y))
-        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = conv1x1(self.features * 4, name="conv3")(y)
         y = bn(name="bn3")(y)
         if self.projection:
-            residual = conv(self.features * 4, (1, 1), strides=s,
-                            name="proj_conv")(x)
+            residual = conv1x1(self.features * 4, strides=s,
+                               name="proj_conv")(x)
             residual = bn(name="proj_bn")(residual)
         return nn.relu(y + residual)
 
@@ -66,6 +77,7 @@ class ResNet(nn.Module):
     v1_downsample: bool = False
     bn_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    quantize_compute: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -82,6 +94,7 @@ class ResNet(nn.Module):
                 x = Bottleneck(features, strides=strides, projection=(j == 0),
                                v1_downsample=self.v1_downsample,
                                bn_eps=self.bn_eps, dtype=self.dtype,
+                               quantize_compute=self.quantize_compute,
                                name=f"stage{i + 1}_block{j + 1}")(x)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
@@ -95,7 +108,24 @@ class ResNet50Serving(ImageClassifierServing):
             v1_downsample=bool(cfg.options.get("v1_downsample", False)),
             bn_eps=float(cfg.options.get("bn_eps", 1e-5)),
             dtype=jnp.dtype(cfg.dtype),
+            # "int8c": bottleneck 1x1 convs on the MXU's int8 path via
+            # Int8Conv1x1 (see int8c_native_kernel_paths).
+            quantize_compute=cfg.quantize == "int8c",
         )
+
+    def int8c_native_kernel_paths(self):
+        """The bottleneck 1x1 convs Int8Conv1x1 consumes natively under
+        int8c (~45% of network FLOPs); 3x3/7x7 convs and BN stay on the
+        weight-only dequant path.
+
+        MEASURED CAVEAT (BASELINE.md "Int8 COMPUTE", 2026-07-30): on v5e
+        at batch 256 this path is 0.78x bf16 — per-pixel activation
+        quantization over large spatial activations costs more than the
+        int8 MACs save, and the extracted 1x1 forfeits conv+BN+ReLU
+        fusion. Prefer quantize="int8" for ResNet on v5e; int8c's win is
+        transformer matmul sites (BERT +12%). Kept because the tradeoff
+        is chip-dependent and the path is parity-tested."""
+        return [r"(conv1|conv3|proj_conv)/kernel$"]
 
     def import_tf_variables(self, flat):
         """Keras-applications ResNet50 names/layouts -> this Flax pytree.

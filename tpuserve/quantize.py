@@ -143,6 +143,13 @@ def dequantize_tree(params: Any, dtype: Any) -> Any:
 # those leaves quantized in the compiled forward and dequantizes the rest
 # as in plain "int8" mode. Accuracy is gated the same way as storage int8:
 # tests/test_quantize.py drift bounds + the imported-weight parity test.
+#
+# Measured guidance (BASELINE.md "Int8 COMPUTE", v5e 2026-07-30): int8c
+# WINS on matmul-dense transformer sites (BERT FFN: +11.8% at the serving
+# bucket) and LOSES on conv sites (ResNet 1x1: 0.78x — per-pixel dynamic
+# activation quantization over large spatial activations outweighs the
+# int8 MAC saving and breaks conv+BN+ReLU fusion). Default to "int8" for
+# conv families; reach for "int8c" where the FLOPs live in big matmuls.
 
 import re  # noqa: E402  (stdlib; used by the int8c path filter below)
 
@@ -196,6 +203,37 @@ class Int8Dense(nn.Module):
         else:
             y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
         return y + bias.astype(self.dtype)
+
+
+class Int8Conv1x1(nn.Module):
+    """Drop-in twin of ``nn.Conv(features, (1, 1), use_bias=False)`` for
+    the int8c path: a 1x1 convolution is a matmul over the channel axis,
+    so a quantized kernel runs int8 x int8 -> int32 on the MXU
+    (``int8_matmul``) after optional spatial striding (valid for 1x1
+    windows: output (i, j) reads exactly input (i*s, j*s)). Param path,
+    shape (1, 1, Cin, Cout), and init match ``nn.Conv``, so import
+    mappers, partition rules, and checkpoints see no difference; a plain
+    float kernel takes the ordinary dense conv-as-matmul path.
+    """
+
+    features: int
+    strides: tuple = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (1, 1, x.shape[-1], self.features), jnp.float32)
+        sh, sw = self.strides
+        if (sh, sw) != (1, 1):
+            x = x[:, ::sh, ::sw, :]
+        cin = x.shape[-1]
+        if is_quantized(kernel):
+            wq = kernel[QKEY].reshape(cin, self.features)
+            return int8_matmul(x, wq, kernel[SKEY], self.dtype)
+        w = kernel.astype(self.dtype).reshape(cin, self.features)
+        return jnp.dot(x.astype(self.dtype), w)
 
 
 def dequantize_tree_except(params: Any, dtype: Any,
