@@ -135,8 +135,9 @@ def run_family(name: str) -> int:
     fam = FAMILIES[name]
     quantize = os.environ.get("BENCHC_QUANTIZE") or None
     if quantize:
-        if quantize != "int8":
-            raise SystemExit(f"BENCHC_QUANTIZE must be 'int8', got {quantize!r}")
+        if quantize not in ("int8", "int8c"):
+            raise SystemExit(
+                f"BENCHC_QUANTIZE must be 'int8' or 'int8c', got {quantize!r}")
         # Applies to every family this invocation runs — stated in the
         # header and the result line so rows can't be mistaken for bf16.
         fam["model"]["quantize"] = quantize

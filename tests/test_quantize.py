@@ -179,6 +179,7 @@ def test_int8c_bert_serves_with_bounded_drift():
         build_runtime(build(_toy_cfg(quantize="int8c")))
 
 
+@pytest.mark.slow  # two full ResNet-50 AOT compiles
 def test_int8c_resnet_serves_with_bounded_drift():
     """ResNet-50's int8c site (bottleneck 1x1 convs via Int8Conv1x1,
     including the strided v1-downsample and projection variants): top-1
