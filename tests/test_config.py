@@ -122,6 +122,28 @@ def test_example_serve_all_toml_parses_and_builds():
         build(m)
 
 
+def test_example_bert_modes_toml_parses_and_builds():
+    """The r5 modes example (int8c + pipeline serving) parses and both
+    models construct with their modes wired."""
+    import os
+
+    from tpuserve.models import build
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "bert_modes.toml")
+    cfg = load_config(path)
+    by_name = {m.name: m for m in cfg.models}
+    assert by_name["bert-i8c"].quantize == "int8c"
+    assert by_name["bert-pp"].parallelism == "pipeline"
+    assert by_name["bert-pp"].pp == 4
+    for m in cfg.models:
+        model = build(m)
+        if m.name == "bert-i8c":
+            assert model.int8c_native_kernel_paths()
+        else:
+            assert model.pipeline_capable
+
+
 def test_warmup_and_describe_cli(tmp_path, capsys):
     """C10: `warmup` builds+compiles from a TOML config and prints the
     runtime inventory; `describe` prints the device/mesh view."""

@@ -103,9 +103,13 @@ def test_end_to_end_toy_matches_fp_serving():
     assert out_q["indices"][0][0] == out_fp["indices"][0][0]
 
 
-def test_tp_sharded_quantized_bert_runs():
-    """int8 weights + TP: scales shard with their weights over the model
-    axis and the forward stays finite (8 fake CPU devices)."""
+@pytest.mark.parametrize("mode", ["int8", "int8c"])
+def test_tp_sharded_quantized_bert_runs(mode):
+    """Quantized weights + TP: scales shard with their weights over the
+    model axis and the forward stays finite (8 fake CPU devices). The
+    int8c variant additionally proves the int8 dot_general partitions
+    under GSPMD with the FFN kernels kept quantized (the int8-compute x
+    tensor-parallel composition)."""
     if len(jax.devices()) < 4:
         pytest.skip("needs multi-device mesh")
     from tpuserve.parallel import make_mesh
@@ -115,7 +119,7 @@ def test_tp_sharded_quantized_bert_runs():
     cfg = ModelConfig(
         name="bert", family="bert", parallelism="sharded", tp=2,
         batch_buckets=[2], seq_buckets=[16], dtype="float32", num_classes=4,
-        quantize="int8", quantize_min_size=256,
+        quantize=mode, quantize_min_size=256,
         options={"layers": 1, "d_model": 32, "heads": 2, "d_ff": 64,
                  "vocab_size": 512},
     )
@@ -126,6 +130,11 @@ def test_tp_sharded_quantized_bert_runs():
                              "application/json")
     out = rt.fetch(rt.run(bucket, model.assemble([item, item], bucket)))
     assert np.isfinite(out["probs"]).all()
+    if mode == "int8c":
+        # The kept-quantized FFN kernels really are sharded int8 on device.
+        q8 = rt.params_per_mesh[0]["params"]["layer0"]["mlp_up"]["kernel"]["q8"]
+        assert q8.dtype == np.int8
+        assert len(q8.addressable_shards) >= 2
 
 
 def test_int8_matmul_matches_dequant_dense():
