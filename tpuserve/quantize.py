@@ -205,6 +205,91 @@ class Int8Dense(nn.Module):
         return y + bias.astype(self.dtype)
 
 
+class _Int8QKVProj(nn.Module):
+    """One q/k/v projection with ``nn.MultiHeadDotProductAttention``'s
+    exact param layout — kernel (d, heads, head_dim), bias (heads,
+    head_dim) — so the module slots under the same ``attn/{query,key,
+    value}`` paths the import mappers and checkpoints use. A quantized
+    kernel (per-head-dim scales, (1, 1, head_dim)) runs int8 on the MXU
+    with the scale broadcast across heads."""
+
+    heads: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (d, self.heads, self.head_dim), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.heads, self.head_dim), jnp.float32)
+        if is_quantized(kernel):
+            wq = kernel[QKEY].reshape(d, self.heads * self.head_dim)
+            scale = jnp.broadcast_to(
+                kernel[SKEY].astype(jnp.float32),
+                (1, self.heads, self.head_dim)).reshape(-1)
+            y = int8_matmul(x, wq, scale, self.dtype)
+        else:
+            y = jnp.dot(x.astype(self.dtype),
+                        kernel.astype(self.dtype).reshape(d, -1))
+        y = y.reshape(x.shape[:-1] + (self.heads, self.head_dim))
+        return y + bias.astype(self.dtype)
+
+
+class _Int8OutProj(nn.Module):
+    """The attention output projection, MHDPA layout: kernel (heads,
+    head_dim, d), bias (d,); int8 path reshapes to a (h*hd, d) matmul."""
+
+    d_model: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, y):  # (..., heads, head_dim)
+        h, hd = y.shape[-2], y.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (h, hd, self.d_model), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.d_model,), jnp.float32)
+        flat = y.reshape(y.shape[:-2] + (h * hd,))
+        if is_quantized(kernel):
+            wq = kernel[QKEY].reshape(h * hd, self.d_model)
+            out = int8_matmul(flat, wq, kernel[SKEY], self.dtype)
+        else:
+            out = jnp.dot(flat.astype(self.dtype),
+                          kernel.astype(self.dtype).reshape(-1, self.d_model))
+        return out + bias.astype(self.dtype)
+
+
+class Int8SelfAttention(nn.Module):
+    """Drop-in for ``nn.MultiHeadDotProductAttention(name="attn")(x)``
+    self-attention under int8c: q/k/v/out projections may arrive
+    int8-quantized (identical param tree to MHDPA — import mappers,
+    partition rules, and checkpoints unaffected); the attention math
+    itself runs through the caller's ``attention_fn`` exactly as MHDPA
+    would call it."""
+
+    heads: int
+    dtype: Any = jnp.bfloat16
+    attention_fn: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        if d % self.heads:
+            # Mirror MHDPA's loud failure: a silent floor here would build
+            # a structurally different (narrower) attention than the
+            # non-quantized path (r5 review finding).
+            raise ValueError(
+                f"feature dim {d} must be divisible by heads {self.heads}")
+        hd = d // self.heads
+        q = _Int8QKVProj(self.heads, hd, self.dtype, name="query")(x)
+        k = _Int8QKVProj(self.heads, hd, self.dtype, name="key")(x)
+        v = _Int8QKVProj(self.heads, hd, self.dtype, name="value")(x)
+        o = self.attention_fn(q, k, v)
+        return _Int8OutProj(d, self.dtype, name="out")(o)
+
+
 class Int8Conv1x1(nn.Module):
     """Drop-in twin of ``nn.Conv(features, (1, 1), use_bias=False)`` for
     the int8c path: a 1x1 convolution is a matmul over the channel axis,
