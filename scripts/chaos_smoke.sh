@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Chaos smoke: the tier-1 fast suite plus the chaos suite (including its
+# slow tests) under forced-CPU JAX. Intended for CI and pre-merge runs;
+# see docs/ROBUSTNESS.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+echo "== tier-1 (fast, -m 'not slow') =="
+python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider
+
+echo "== chaos suite (tests/test_faults.py, all tiers) =="
+python -m pytest tests/test_faults.py -q -p no:cacheprovider
+
+echo "chaos smoke OK"

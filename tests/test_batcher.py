@@ -9,6 +9,7 @@ import pytest
 
 from tpuserve.batcher import ModelBatcher, QueueFull
 from tpuserve.config import ModelConfig
+from tpuserve.faults import FaultInjected, FaultInjector
 from tpuserve.models import build
 from tpuserve.obs import Metrics
 from tpuserve.runtime import build_runtime
@@ -86,22 +87,77 @@ def test_fault_containment(rt_model):
     async def go():
         b, metrics = make_batcher(rt_model, deadline_ms=20.0)
         await b.start()
-        boom = {"on": True}
-
-        def hook():
-            if boom["on"]:
-                raise RuntimeError("injected fault")
-
-        b.fault_hook = hook
+        b.injector = FaultInjector.single("batch_error", metrics=metrics)
         fut = b.submit(item())
-        with pytest.raises(RuntimeError, match="injected fault"):
+        with pytest.raises(FaultInjected, match="injected fault"):
             await asyncio.wait_for(fut, timeout=10)
         assert metrics.counter("batch_errors_total{model=toy}").value == 1
         # server keeps serving after the failed batch
-        boom["on"] = False
+        b.injector = None
         res = await asyncio.wait_for(b.submit(item()), timeout=10)
         assert "top_k" in res
         await b.stop()
+
+    run(go())
+
+
+def test_transient_fault_retried_transparently(rt_model):
+    """batch_retry: a fault that fires once is absorbed by the one-shot
+    retry — the client sees a normal result, not a 500."""
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=20.0, batch_retry=True)
+        await b.start()
+        b.injector = FaultInjector.single("batch_error", count=1,
+                                          metrics=metrics)
+        res = await asyncio.wait_for(b.submit(item()), timeout=10)
+        assert "top_k" in res
+        assert metrics.counter("batch_errors_total{model=toy}").value == 1
+        assert metrics.counter("batch_retries_total{model=toy}").value == 1
+        assert metrics.counter("batch_retry_failures_total{model=toy}").value == 0
+        await b.stop()
+
+    run(go())
+
+
+class _PoisonModel:
+    """Delegating wrapper whose assemble raises when a poison item (all-255
+    image) is in the batch — the whole-batch failure mode a single bad
+    request induces."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def assemble(self, items, bucket):
+        if any(int(np.min(it)) == 255 for it in items):
+            raise RuntimeError("poison item in batch")
+        return self._inner.assemble(items, bucket)
+
+
+def test_poison_item_isolated_by_split_retry(rt_model):
+    """Split retry: one poison item in a full batch fails ONLY its own
+    future; every other lane succeeds after the bisection."""
+    async def go():
+        model, rt = rt_model
+        for k, v in dict(deadline_ms=10_000.0, max_queue=16, batch_retry=True,
+                         retry_split=True).items():
+            setattr(model.cfg, k, v)
+        metrics = Metrics()
+        pool = cf.ThreadPoolExecutor(max_workers=4)
+        b = ModelBatcher(_PoisonModel(model), rt, metrics, pool)
+        await b.start()
+        good = [b.submit(item()) for _ in range(3)]
+        poison = b.submit(np.full((8, 8, 3), 255, dtype=np.uint8))
+        results = await asyncio.wait_for(
+            asyncio.gather(*good, poison, return_exceptions=True), timeout=30)
+        await b.stop()
+        assert all("top_k" in r for r in results[:3])
+        assert isinstance(results[3], RuntimeError)
+        assert "poison" in str(results[3])
+        assert metrics.counter("poison_items_total{model=toy}").value == 1
+        assert metrics.counter("batch_retries_total{model=toy}").value == 1
 
     run(go())
 

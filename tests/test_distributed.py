@@ -163,6 +163,11 @@ def test_two_process_collectives_across_the_dcn_seam():
                 lg.seek(0)
                 outs.append(lg.read())
                 lg.close()
+        if any("Multiprocess computations aren't implemented on the CPU"
+               in out for out in outs):
+            pytest.skip("this jaxlib's CPU backend lacks multi-process "
+                        "collectives; the DCN-seam check needs a newer jax "
+                        "or real hardware")
         for r, out in enumerate(outs):
             assert f"RANK{r} OK total=28.0 hosts=2" in out, (r, out[-2000:])
 

@@ -268,7 +268,11 @@ def test_check_vma_false_still_required_canary():
     delete the check_vma=False escapes in tpuserve/ops/ring_attention.py
     and tpuserve/models/bert.py and regain the stronger collective
     checking (VERDICT r4 weak 7 asked for exactly this tripwire)."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        pytest.skip("this jax predates vma tracking (check_rep era); the "
+                    "escapes route through tpuserve.utils.compat instead")
     from jax.sharding import PartitionSpec as P
 
     from tpuserve.parallel import make_mesh

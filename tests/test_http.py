@@ -329,14 +329,15 @@ def test_periodic_canary_degrades_and_recovers(loop):
         try:
             assert (await client.get("/healthz")).status == 200
             # Live failure: every batch dispatch now raises.
-            state.batchers["toy"].fault_hook = lambda: (_ for _ in ()).throw(
-                RuntimeError("injected"))
+            from tpuserve.faults import FaultInjector
+
+            state.batchers["toy"].injector = FaultInjector.single("batch_error")
             await asyncio.sleep(0.5)
             r = await client.get("/healthz")
             assert r.status == 503, await r.text()
             assert (await r.json())["status"] == "degraded"
             # Recovery.
-            state.batchers["toy"].fault_hook = None
+            state.batchers["toy"].injector = None
             await asyncio.sleep(0.5)
             assert (await client.get("/healthz")).status == 200
         finally:

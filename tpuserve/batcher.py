@@ -16,9 +16,15 @@ shapes, so this batcher assembles **padded, bucketed** batches:
   device at once (assembly, H2D and the blocking D2H fetch run in a
   threadpool; the event loop never blocks), hiding H2D under compute.
 
-Failure containment (SURVEY.md §5): an executable failure fails only that
-batch's futures; the group task and server keep serving. Client disconnects
-cancel futures, which are dropped at flush time.
+Failure containment (SURVEY.md §5, docs/ROBUSTNESS.md): a failed dispatch
+first re-assembles and re-runs the batch once (``batch_retry``); if the
+retry also fails the batch recursively bisects (``retry_split``) so a single
+poison item fails only its own future while the other lanes succeed. Only
+then do futures carry the error. Dispatch outcomes feed the per-model
+circuit breaker, an optional FaultInjector supplies deterministic chaos at
+the dispatch call sites, and dead group tasks are revived by the server
+watchdog (``revive_group_loops``). Client disconnects cancel futures, which
+are dropped at flush time.
 """
 
 from __future__ import annotations
@@ -58,6 +64,8 @@ class ModelBatcher:
         runtime: "ModelRuntime | Any",
         metrics: Metrics,
         pool: cf.ThreadPoolExecutor,
+        breaker: "Any | None" = None,
+        injector: "Any | None" = None,
     ) -> None:
         self.model = model
         self.runtime = runtime
@@ -68,13 +76,16 @@ class ModelBatcher:
         self.pool = pool
         self.cfg = model.cfg
         self._queues: dict[Hashable, asyncio.Queue[_Request]] = {}
-        self._tasks: list[asyncio.Task] = []
+        self._tasks: dict[Hashable, asyncio.Task] = {}
         self._dispatch_tasks: set[asyncio.Task] = set()
         self._inflight: asyncio.Semaphore | None = None
         self._pending = 0
         self._running = False
-        # test-only fault injection hook: callable raised inside dispatch
-        self.fault_hook = None
+        # Per-model circuit breaker (tpuserve.faults.CircuitBreaker): fed
+        # dispatch outcomes here, consulted by the HTTP layer.
+        self.breaker = breaker
+        # Deterministic chaos (tpuserve.faults.FaultInjector); None in prod.
+        self.injector = injector
 
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
@@ -84,13 +95,13 @@ class ModelBatcher:
     async def stop(self) -> None:
         """Cancel accumulation, fail queued requests, drain in-flight batches."""
         self._running = False
-        for t in self._tasks:
+        for t in self._tasks.values():
             t.cancel()
-        for t in self._tasks:
+        for t in self._tasks.values():
             try:
                 await t
-            except asyncio.CancelledError:
-                pass
+            except (asyncio.CancelledError, Exception):
+                pass  # a loop that already died must not abort stop()
         self._tasks.clear()
         # Requests still queued (never dispatched) must not hang their
         # clients: fail them explicitly (ADVICE r1: stop() cleared queues
@@ -120,17 +131,56 @@ class ModelBatcher:
         q = self._queues.get(group)
         if q is None:
             q = self._queues[group] = asyncio.Queue()
-            self._tasks.append(loop.create_task(self._group_loop(group, q)))
+            self._tasks[group] = loop.create_task(self._group_loop(group, q))
         q.put_nowait(req)
         self._pending += 1
         self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
         return fut
+
+    def revive_group_loops(self) -> int:
+        """Watchdog hook: restart group-accumulation tasks that died.
+
+        A group loop only exits on stop() (cancelled while not running); any
+        other completion — an escaped exception, an injected kill — orphans
+        its queue and hangs every future routed to that group. The watchdog
+        calls this on its sweep; requests the dead loop had already pulled
+        into its local batch are lost (their futures resolve at the server's
+        request timeout), but everything still queued is served by the
+        revived task."""
+        if not self._running:
+            return 0
+        revived = 0
+        loop = asyncio.get_running_loop()
+        for group, q in self._queues.items():
+            t = self._tasks.get(group)
+            if t is not None and not t.done():
+                continue
+            if t is not None and not t.cancelled() and t.exception() is not None:
+                log.error("group loop %r for %s died: %r — restarting",
+                          group, self.model.name, t.exception())
+            self._tasks[group] = loop.create_task(self._group_loop(group, q))
+            revived += 1
+        return revived
+
+    async def drain(self, deadline: float) -> bool:
+        """Graceful drain: wait until every accepted request (queued or in
+        flight) has resolved, bounded by ``deadline`` (event-loop time).
+        The caller stops admitting new work first (server.draining)."""
+        loop = asyncio.get_running_loop()
+        while (self._pending > 0 or self._dispatch_tasks) \
+                and loop.time() < deadline:
+            await asyncio.sleep(0.02)
+        return self._pending == 0 and not self._dispatch_tasks
 
     # -- accumulation (event loop) ------------------------------------------
     async def _group_loop(self, group: Hashable, q: asyncio.Queue) -> None:
         max_bucket = max(self.cfg.batch_buckets)
         deadline_s = self.cfg.deadline_ms / 1e3
         while True:
+            if self.injector is not None:
+                # Chaos: an escaped exception kills this task, exactly the
+                # failure revive_group_loops exists to repair.
+                self.injector.check("kill_group_loop", self.model.name)
             req = await q.get()
             batch = [req]
             try:
@@ -177,71 +227,142 @@ class ModelBatcher:
 
     # -- dispatch (threadpool does the blocking work) ------------------------
     async def _dispatch(self, reqs: list[_Request], group: Hashable) -> None:
+        """Run one batch; on failure, retry/split per config before failing
+        futures. Failure is contained to this batch either way: the group
+        task and server keep serving."""
+        name = self.model.name
+        released = [False]  # deferred mode releases the semaphore mid-flight
+        try:
+            try:
+                await self._execute(reqs, group, released)
+            except Exception as e:
+                log.exception("batch dispatch failed for %s", name)
+                self.metrics.counter(f"batch_errors_total{{model={name}}}").inc()
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                live = [r for r in reqs if not r.future.done()]
+                if self.cfg.batch_retry and live:
+                    try:
+                        await self._retry(live, group, released)
+                    except Exception as retry_err:
+                        # The retry machinery itself must never leave
+                        # futures unresolved (clients would hang to 504).
+                        log.exception("batch retry machinery failed for %s", name)
+                        for r in live:
+                            if not r.future.done():
+                                r.future.set_exception(retry_err)
+                else:
+                    for r in live:
+                        r.future.set_exception(e)
+        finally:
+            if not released[0]:
+                self._inflight.release()
+
+    async def _execute(self, reqs: list[_Request], group: Hashable,
+                       released: list[bool]) -> None:
+        """Assemble + run + postprocess one batch, resolving futures on
+        success. Raises on failure WITHOUT failing futures — the caller
+        owns the retry policy."""
         loop = asyncio.get_running_loop()
         name = self.model.name
-        sem_released = False
-        try:
-            bucket = self.model.bucket_for(len(reqs), group=group)
-            fill = len(reqs) / bucket[0]
-            self.metrics.gauge(f"batch_fill_ratio{{model={name}}}").set(fill)
-            self.metrics.counter(f"batches_total{{model={name}}}").inc()
+        bucket = self.model.bucket_for(len(reqs), group=group)
+        fill = len(reqs) / bucket[0]
+        self.metrics.gauge(f"batch_fill_ratio{{model={name}}}").set(fill)
+        self.metrics.counter(f"batches_total{{model={name}}}").inc()
 
-            t0 = time.perf_counter()
-            items = [r.item for r in reqs]
-            host_batch = await loop.run_in_executor(
-                self.pool, self.model.assemble, items, bucket
-            )
-            t1 = time.perf_counter()
-            self.metrics.observe_phase(name, "preproc", (t1 - t0) * 1e3)
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        items = [r.item for r in reqs]
+        host_batch = await loop.run_in_executor(
+            self.pool, self.model.assemble, items, bucket
+        )
+        t1 = time.perf_counter()
+        self.metrics.observe_phase(name, "preproc", (t1 - t0) * 1e3)
 
-            if self.fault_hook is not None:
-                self.fault_hook()
+        if self.injector is not None:
+            delay = self.injector.delay_s("slow_dispatch", name)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            self.injector.check("batch_error", name)
 
-            if self.deferred:
-                # Deferred mode: enqueue is cheap (shm write + slot wait = the
-                # backpressure), so the inflight semaphore is released as soon
-                # as the batch is on its worker; the await then spans the rest
-                # of the owning worker's epoch + bulk readback, which is what
-                # "compute" measures in this mode by design.
-                out_fut = await self.runtime.enqueue(bucket, host_batch)
-                t2 = time.perf_counter()
-                self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+        if self.deferred:
+            # Deferred mode: enqueue is cheap (shm write + slot wait = the
+            # backpressure), so the inflight semaphore is released as soon
+            # as the batch is on its worker; the await then spans the rest
+            # of the owning worker's epoch + bulk readback, which is what
+            # "compute" measures in this mode by design.
+            out_fut = await self.runtime.enqueue(bucket, host_batch)
+            t2 = time.perf_counter()
+            self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+            if not released[0]:
                 self._inflight.release()
-                sem_released = True
-                np_out = await out_fut
-                t3 = time.perf_counter()
-                self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
-            else:
-                outputs = await loop.run_in_executor(self.pool, self.runtime.run, bucket, host_batch)
-                t2 = time.perf_counter()
-                self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+                released[0] = True
+            np_out = await out_fut
+            t3 = time.perf_counter()
+            self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+        else:
+            outputs = await loop.run_in_executor(self.pool, self.runtime.run, bucket, host_batch)
+            t2 = time.perf_counter()
+            self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
 
-                # "compute" = dispatch-to-ready wall time. With pipelined
-                # dispatch that includes waiting behind the other in-flight
-                # batches' transfers, so on a transfer-bound link this phase
-                # absorbs the wire wait (BASELINE.md "Link physics"), not
-                # just MXU time.
-                np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
-                t3 = time.perf_counter()
-                self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+            # "compute" = dispatch-to-ready wall time. With pipelined
+            # dispatch that includes waiting behind the other in-flight
+            # batches' transfers, so on a transfer-bound link this phase
+            # absorbs the wire wait (BASELINE.md "Link physics"), not
+            # just MXU time.
+            np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
+            t3 = time.perf_counter()
+            self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
 
-            results = self.model.host_postprocess(np_out, len(reqs))
-            t4 = time.perf_counter()
-            self.metrics.observe_phase(name, "postproc", (t4 - t3) * 1e3)
-            self.metrics.counter(f"items_total{{model={name}}}").inc(len(reqs))
-            self.metrics.tracer.add(
-                f"batch[{bucket}]", time.time() - (t4 - t0), time.time(),
-                tid=name, n=len(reqs), fill=fill,
-            )
-            for r, res in zip(reqs, results):
-                if not r.future.done():
-                    r.future.set_result(res)
-        except Exception as e:  # contain: fail only this batch
-            log.exception("batch dispatch failed for %s", name)
-            self.metrics.counter(f"batch_errors_total{{model={name}}}").inc()
-            for r in reqs:
-                if not r.future.done():
-                    r.future.set_exception(e)
-        finally:
-            if not sem_released:
-                self._inflight.release()
+        results = self.model.host_postprocess(np_out, len(reqs))
+        t4 = time.perf_counter()
+        self.metrics.observe_phase(name, "postproc", (t4 - t3) * 1e3)
+        self.metrics.counter(f"items_total{{model={name}}}").inc(len(reqs))
+        # Span start/duration from the same wall-clock capture: mixing a
+        # perf_counter delta into a fresh time.time() read skewed span
+        # starts by the time spent between the two calls.
+        self.metrics.tracer.add(
+            f"batch[{bucket}]", wall0, wall0 + (t4 - t0),
+            tid=name, n=len(reqs), fill=fill,
+        )
+        if self.breaker is not None:
+            self.breaker.record_success()
+        for r, res in zip(reqs, results):
+            if not r.future.done():
+                r.future.set_result(res)
+
+    async def _retry(self, reqs: list[_Request], group: Hashable,
+                     released: list[bool]) -> None:
+        """One-shot batch retry with poison isolation.
+
+        The whole batch re-assembles and re-runs once (absorbing transient
+        faults); if that fails and ``retry_split`` is on, the batch bisects
+        recursively — each sub-batch runs once — so a single poison item
+        fails only its own future while every other lane succeeds. Worst
+        case a lane re-runs O(log batch) times; every path ends with all
+        futures resolved."""
+        name = self.model.name
+        self.metrics.counter(f"batch_retries_total{{model={name}}}").inc()
+
+        async def run_split(rs: list[_Request]) -> None:
+            live = [r for r in rs if not r.future.done()]
+            if not live:
+                return
+            try:
+                await self._execute(live, group, released)
+            except Exception as e:
+                self.metrics.counter(
+                    f"batch_retry_failures_total{{model={name}}}").inc()
+                if len(live) == 1 or not self.cfg.retry_split:
+                    if len(live) == 1 and self.cfg.retry_split:
+                        self.metrics.counter(
+                            f"poison_items_total{{model={name}}}").inc()
+                    for r in live:
+                        if not r.future.done():
+                            r.future.set_exception(e)
+                else:
+                    mid = (len(live) + 1) // 2
+                    await run_split(live[:mid])
+                    await run_split(live[mid:])
+
+        await run_split(reqs)

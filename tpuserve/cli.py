@@ -4,6 +4,7 @@ Usage::
 
     python -m tpuserve serve  --config serve.toml [--set port=9000 ...]
     python -m tpuserve bench  --url http://127.0.0.1:8000 --model resnet50 ...
+    python -m tpuserve chaos  --config chaos.toml --min-availability 0.99
     python -m tpuserve import-model --saved-model DIR --family resnet50 --out CKPT
     python -m tpuserve warmup --config serve.toml   (compile + persist XLA cache)
     python -m tpuserve describe                      (device/mesh inventory)
@@ -87,6 +88,21 @@ def main(argv: list[str] | None = None) -> int:
                       help="model option/field (TOML-parsed), e.g. "
                            "--opt image_size=512 --opt det_classes=90")
 
+    p_chaos = sub.add_parser(
+        "chaos",
+        help="serve a fault-injected config on an ephemeral port, drive the "
+             "load generator at it, and report availability (staging drills)")
+    _add_config_args(p_chaos)
+    p_chaos.add_argument("--model", default=None,
+                         help="model to load test (default: first configured)")
+    p_chaos.add_argument("--duration", type=float, default=10.0)
+    p_chaos.add_argument("--warmup", type=float, default=1.0)
+    p_chaos.add_argument("--concurrency", type=int, default=16)
+    p_chaos.add_argument("--rate", type=float, default=None,
+                         help="open-loop offered rate (req/s); default closed loop")
+    p_chaos.add_argument("--min-availability", type=float, default=0.0,
+                         help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
+
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
 
@@ -113,6 +129,27 @@ def main(argv: list[str] | None = None) -> int:
         from tpuserve.bench.loadgen import run_loadgen_cli
 
         return run_loadgen_cli(args)
+
+    if args.cmd == "chaos":
+        import asyncio
+
+        from tpuserve.config import default_config, load_config
+        from tpuserve.faults import run_chaos
+        from tpuserve.parallel import init_distributed
+        from tpuserve.server import ServerState, configure_logging
+
+        cfg = load_config(args.config, args.overrides) if args.config else default_config()
+        configure_logging(cfg)
+        init_distributed(cfg.distributed)
+        state = ServerState(cfg)
+        state.build()
+        model = args.model or cfg.models[0].name
+        summary = asyncio.run(run_chaos(
+            state, model, duration_s=args.duration, warmup_s=args.warmup,
+            concurrency=args.concurrency, rate_per_s=args.rate,
+            edge=cfg.model(model).wire_size))
+        print(json.dumps(summary, indent=2))
+        return 0 if summary["availability"] >= args.min_availability else 1
 
     if args.cmd == "import-model":
         from tpuserve import savedmodel

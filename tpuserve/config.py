@@ -19,9 +19,66 @@ Example TOML::
 from __future__ import annotations
 
 import dataclasses
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is the same parser
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import Any
+
+
+# Fault kinds the chaos injector understands (tpuserve.faults.FaultInjector).
+# Each names a call site on the serving path where an armed rule can fire.
+FAULT_KINDS = (
+    "batch_error",      # raise inside batch dispatch (batcher._execute)
+    "slow_dispatch",    # sleep delay_ms inside batch dispatch
+    "decode_corrupt",   # fail request decode -> HTTP 400
+    "worker_death",     # kill the active deferred worker process
+    "canary_fail",      # fail the per-model canary probe
+    "device_error",     # raise inside ModelRuntime.run (below the batcher)
+    "slow_compute",     # sleep delay_ms inside ModelRuntime.run
+    "kill_group_loop",  # crash the group accumulation task (watchdog food)
+)
+
+
+@dataclass
+class FaultRuleConfig:
+    """One armed chaos rule (TOML ``[[faults.rule]]``; tpuserve.faults)."""
+
+    # Which call site fires (see FAULT_KINDS).
+    kind: str = "batch_error"
+    # Model name the rule applies to; "*" matches every model.
+    model: str = "*"
+    # Per-call-site chance of firing, drawn from a rule-local seeded RNG so
+    # runs are reproducible.
+    probability: float = 1.0
+    # Max times the rule fires; -1 = unlimited.
+    count: int = -1
+    # Sleep for the slow_* kinds (ignored by the others).
+    delay_ms: float = 0.0
+    # Rule-local RNG seed; 0 derives one from FaultsConfig.seed + rule index.
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {list(FAULT_KINDS)}")
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got {self.probability}")
+
+
+@dataclass
+class FaultsConfig:
+    """Deterministic fault injection for chaos testing (``[faults]`` TOML).
+
+    Off by default; staging configs arm rules to prove the recovery machinery
+    (retry, breaker, watchdog, drain) holds the latency SLO while degraded."""
+
+    enabled: bool = False
+    # Base seed rule-local RNGs derive from (reproducible chaos runs).
+    seed: int = 0
+    rules: list[FaultRuleConfig] = field(default_factory=list)
 
 
 @dataclass
@@ -113,6 +170,22 @@ class ModelConfig:
     relay_epoch_ms: float = 2000.0
     # recycle mode: per-worker shared-memory batch slots (in-flight batches).
     relay_slots: int = 4
+    # -- robustness (docs/ROBUSTNESS.md) ------------------------------------
+    # One-shot batch retry: a failed dispatch re-assembles and re-runs the
+    # batch once before failing its futures (absorbs transient device/worker
+    # faults without the client seeing a 500).
+    batch_retry: bool = True
+    # When the whole-batch retry also fails, recursively bisect so a single
+    # poison item fails only its own future while the other lanes succeed.
+    retry_split: bool = True
+    # Circuit breaker: consecutive failed dispatches before the model trips
+    # to fast 503 + Retry-After (0 disables). Half-opens via the canary path:
+    # canary inferences keep riding the batcher while open, and the first
+    # success closes the breaker.
+    breaker_threshold: int = 5
+    # Retry-After hint (s) on breaker 503s when no periodic canary is
+    # configured; with canary_interval_s > 0 the hint is the canary interval.
+    breaker_retry_after_s: float = 5.0
 
     def __post_init__(self) -> None:
         if self.tp < 1 or self.sp < 1:
@@ -179,6 +252,16 @@ class ServerConfig:
     # Emit one JSON object per log line (machine-ingestible) instead of the
     # human-readable default.
     log_json: bool = False
+    # Deterministic fault injection (chaos testing); disabled by default.
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
+    # Watchdog sweep interval: restart dead group-accumulation tasks and reap
+    # dead deferred workers every this many seconds (0 disables).
+    watchdog_interval_s: float = 1.0
+    # Graceful-drain budget on SIGTERM: new requests 503 immediately while
+    # every accepted request gets this long to finish before hard stop.
+    drain_timeout_s: float = 30.0
+    # Retry-After hint (seconds) on 429 shed and drain 503 responses.
+    shed_retry_after_s: float = 1.0
 
     def model(self, name: str) -> ModelConfig:
         for m in self.models:
@@ -210,10 +293,15 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
+    faults_dict = raw.pop("faults", None)
     cfg: ServerConfig = _build(ServerConfig, raw)
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
     if dist_dict is not None:
         cfg.distributed = _build(DistributedConfig, dist_dict)
+    if faults_dict is not None:
+        rule_dicts = faults_dict.pop("rule", [])
+        cfg.faults = _build(FaultsConfig, faults_dict)
+        cfg.faults.rules = [_build(FaultRuleConfig, r) for r in rule_dicts]
 
     for ov in overrides or []:
         _apply_override(cfg, ov)

@@ -345,12 +345,17 @@ class DeferredPool:
     """Routes batches to session-recycling workers; resolves futures on epoch
     readback. One pool per recycle-mode model."""
 
-    def __init__(self, mcfg: ModelConfig, cache_dir: str, model) -> None:
+    def __init__(self, mcfg: ModelConfig, cache_dir: str, model,
+                 injector=None) -> None:
         import jax
 
         self.mcfg = mcfg
         self.cache_dir = cache_dir
         self.model = model
+        # Deterministic chaos (tpuserve.faults.FaultInjector); None in prod.
+        # Kind "worker_death" kills the active worker at enqueue time,
+        # exercising the died path + batcher retry + watchdog replenish.
+        self.injector = injector
         # A request's latency in recycle mode ~= its worker's remaining epoch;
         # a request timeout below the epoch would 504 most traffic (judge
         # finding r2). Keep timeout >= 2x epoch + readback headroom.
@@ -495,6 +500,11 @@ class DeferredPool:
                 f"batch totals {total} B but a shm slot holds "
                 f"{self.slot_bytes} B (sized for the largest configured "
                 f"bucket); enqueue batches padded to a configured bucket")
+        if (self.injector is not None and self._active is not None
+                and self._active.proc.is_alive()
+                and self.injector.fire("worker_death", self.model.name)):
+            log.warning("chaos: killing active worker %d", self._active.wid)
+            self._active.proc.kill()  # reader sees EOF -> died path
         async with self._lock:
             while True:
                 w = await self._ensure_active(bucket)
@@ -733,6 +743,35 @@ class DeferredPool:
             "buckets": [list(b) for b in self.model.buckets()],
             "stats": dict(self.stats),
         }
+
+    def watchdog_sweep(self) -> int:
+        """Watchdog hook (event loop): reap dead worker handles and re-top
+        the warm pool in the background.
+
+        The per-worker reader threads normally deliver the "died" message;
+        this is the backstop for a worker that dies without the reader
+        noticing (and the bookkeeping that prunes exited workers from
+        ``_workers``). Returns how many UN-retired workers were found dead —
+        real failures; retired workers exiting is normal lifecycle."""
+        died = 0
+        for w in list(self._workers):
+            if w.proc.is_alive():
+                continue
+            if not w.retired and (w.pending or w in self._warm
+                                  or w is self._active):
+                died += 1
+                self._on_msg(w, {"op": "died",
+                                 "error": "watchdog: process not alive"})
+            if not w.pending:
+                if w in self._warm:
+                    self._warm.remove(w)
+                if self._active is w:
+                    self._active = None
+                self._workers.remove(w)
+                w.close()
+        if not self._stopping and self._loop is not None:
+            self._maybe_replenish()
+        return died
 
     def retire_active(self) -> None:
         """Early-retire every worker holding in-flight batches (fast, sync).

@@ -71,8 +71,9 @@ class BertBlock(nn.Module):
                 # (batch on "data", heads on "model" when tp divides them) —
                 # the supported composition that used to be a build-time
                 # rejection (VERDICT r3 next 3).
-                from jax import shard_map
                 from jax.sharding import PartitionSpec as P
+
+                from tpuserve.utils.compat import shard_map
 
                 head_axis = ("model"
                              if self.heads % self.mesh.shape["model"] == 0

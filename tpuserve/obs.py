@@ -154,6 +154,10 @@ class Tracer:
 
 PHASES = ("queue", "preproc", "h2d", "compute", "postproc", "total")
 
+# Circuit-breaker states as gauge values (breaker_state{model=...}), chosen
+# so "bigger = less healthy" reads naturally on a dashboard.
+BREAKER_STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
 
 class Metrics:
     """Registry of all server metrics. One instance per server process."""

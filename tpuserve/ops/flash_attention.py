@@ -178,11 +178,15 @@ def _flash(q, k, v, bias, block_q, block_k, interpret, return_stats):
     # must declare which mesh axes they vary over; inherit the inputs' union
     # (outside shard_map these are empty sets — no-op).
     vma = frozenset()
-    for x in (q, k, v, bias):
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:  # jax < 0.6 has no typeof (and no vma on avals)
+        for x in (q, k, v, bias):
+            vma = vma | getattr(typeof(x), "vma", frozenset())
 
     def out_struct(shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
 
     if not return_stats:
         out = pl.pallas_call(

@@ -27,7 +27,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from tpuserve.utils.compat import pcast_varying, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -93,9 +93,9 @@ def _ring_body(q, k, v, kbias, axis_name: str, vary_axes: tuple = (),
     # constants as varying over every sharded axis so scan carry types match
     # the loop outputs (which inherit q/k/v's varying axes).
     vary = vary_axes or (axis_name,)
-    m0 = jax.lax.pcast(jnp.full((b, h, sq), -jnp.inf, jnp.float32), vary, to="varying")
-    l0 = jax.lax.pcast(jnp.zeros((b, h, sq), jnp.float32), vary, to="varying")
-    acc0 = jax.lax.pcast(jnp.zeros((b, sq, h, d), jnp.float32), vary, to="varying")
+    m0 = pcast_varying(jnp.full((b, h, sq), -jnp.inf, jnp.float32), vary)
+    l0 = pcast_varying(jnp.zeros((b, h, sq), jnp.float32), vary)
+    acc0 = pcast_varying(jnp.zeros((b, sq, h, d), jnp.float32), vary)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, _):

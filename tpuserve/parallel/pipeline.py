@@ -34,7 +34,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from tpuserve.utils.compat import pcast_varying, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 STAGE_AXIS = "stage"
@@ -84,8 +84,7 @@ def _pp_body(params: Any, xs: jax.Array, *, stage_fn: Callable,
 
     # pcast: the zero init must carry the same varying-over-stage type the
     # loop outputs have (cf. the ring-attention scan carries).
-    init = jax.lax.pcast(jnp.zeros(mb_shape, xs.dtype), (axis_name,),
-                         to="varying")
+    init = pcast_varying(jnp.zeros(mb_shape, xs.dtype), (axis_name,))
     _, outs = jax.lax.scan(tick, init, jnp.arange(n_micro + n_stages - 1))
     # Only the last stage contributed non-zeros; replicate its results.
     outs = jax.lax.psum(outs, axis_name)

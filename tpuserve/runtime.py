@@ -136,6 +136,11 @@ class ModelRuntime:
         self._rr = 0  # round-robin cursor for replica mode
         self._rr_lock = threading.Lock()
         self._reload_lock = threading.Lock()
+        # Deterministic chaos (tpuserve.faults.FaultInjector); None in prod.
+        # Kinds "device_error"/"slow_compute" fire inside run() — below the
+        # batcher — so retry/breaker behavior is proven against failures the
+        # batcher did not itself synthesize.
+        self.injector = None
 
     # -- startup ------------------------------------------------------------
     def load_and_shard_params(self) -> None:
@@ -283,6 +288,11 @@ class ModelRuntime:
 
     def run(self, bucket: tuple, host_batch: Any, replica: int | None = None) -> Any:
         """H2D + async dispatch. Returns device output pytree immediately."""
+        if self.injector is not None:
+            delay = self.injector.delay_s("slow_compute", self.model.name)
+            if delay > 0:
+                time.sleep(delay)  # runs in the batcher's threadpool
+            self.injector.check("device_error", self.model.name)
         exes = self.executables[bucket]
         i = replica if replica is not None else self.pick_replica()
         exe = exes[i]

@@ -22,7 +22,8 @@ All device work happens behind the batcher. Endpoints:
 - ``GET  /``            — minimal HTML upload page for manual poking.
 
 Error mapping: decode failure -> 400, unknown model -> 404, queue full -> 429,
-request deadline exceeded -> 504, batch failure -> 500.
+request deadline exceeded -> 504, batch failure (after retry) -> 500, breaker
+open / draining -> 503. Shed responses (429/503) carry ``Retry-After``.
 """
 
 from __future__ import annotations
@@ -32,6 +33,8 @@ import concurrent.futures as cf
 import contextlib
 import json
 import logging
+import math
+import signal
 import time
 
 from aiohttp import web
@@ -41,6 +44,7 @@ import jax
 from tpuserve import models as modelzoo
 from tpuserve.batcher import ModelBatcher, QueueFull
 from tpuserve.config import ServerConfig
+from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
 from tpuserve.obs import Metrics
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
 
@@ -62,8 +66,18 @@ class ServerState:
         self.models: dict[str, object] = {}
         self.runtimes: dict[str, ModelRuntime] = {}
         self.batchers: dict[str, ModelBatcher] = {}
+        self.breakers: dict[str, CircuitBreaker] = {}
         self.canary_ok: dict[str, bool] = {}
         self._canary_task: asyncio.Task | None = None
+        # Chaos layer (docs/ROBUSTNESS.md): None unless [faults] is armed.
+        self.injector = (FaultInjector(cfg.faults, self.metrics)
+                         if cfg.faults.enabled else None)
+        self.watchdog = Watchdog(cfg.watchdog_interval_s, self.metrics)
+        # Graceful drain: True once shutdown began — new requests shed with
+        # 503 + Retry-After while accepted ones finish.
+        self.draining = False
+        # Bound (host, port) pairs once serve_async is listening.
+        self.serving_addresses: list = []
 
     def build(self) -> None:
         configure_jax(self.cfg)
@@ -80,12 +94,16 @@ class ServerState:
                     # own one PJRT session each.
                     from tpuserve.deferred import DeferredPool
 
-                    rt = DeferredPool(mcfg, self.cfg.compilation_cache_dir, model)
+                    rt = DeferredPool(mcfg, self.cfg.compilation_cache_dir,
+                                      model, injector=self.injector)
                     rt.prewarm()
                 else:
                     rt = build_runtime(model, pool=compile_pool)
                     if self.cfg.prewarm_executables:
                         rt.prewarm()
+                    # Armed after prewarm: chaos targets the serving path,
+                    # not startup.
+                    rt.injector = self.injector
                 self.models[mcfg.name] = model
                 self.runtimes[mcfg.name] = rt
                 log.info("model %s ready in %.1fs: %s", mcfg.name, time.perf_counter() - t0, rt.describe())
@@ -97,13 +115,22 @@ class ServerState:
             rt = self.runtimes[name]
             if hasattr(rt, "enqueue"):  # DeferredPool: bind to the loop
                 await rt.start()
-            b = ModelBatcher(model, rt, self.metrics, self.pool)
+            br = CircuitBreaker(name, model.cfg.breaker_threshold,
+                                self.metrics,
+                                retry_after_s=model.cfg.breaker_retry_after_s)
+            self.breakers[name] = br
+            b = ModelBatcher(model, rt, self.metrics, self.pool,
+                             breaker=br, injector=self.injector)
             await b.start()
             self.batchers[name] = b
+            self.watchdog.register(name, "group_loop", b.revive_group_loops)
+            if hasattr(rt, "watchdog_sweep"):
+                self.watchdog.register(name, "worker", rt.watchdog_sweep)
         if self.cfg.startup_canary:
             await self.run_canaries()
         if self.cfg.canary_interval_s > 0:
             self._canary_task = asyncio.create_task(self._canary_loop())
+        self.watchdog.start()
 
     async def _canary_loop(self) -> None:
         """Re-run the per-model canary on an interval so /healthz reflects
@@ -135,9 +162,16 @@ class ServerState:
         }
 
     async def run_canary(self, name: str, timeout: float = 60.0) -> bool:
-        """Tiny end-to-end inference for one model; feeds /healthz."""
+        """Tiny end-to-end inference for one model; feeds /healthz and
+        half-opens/closes the circuit breaker (canaries ride the batcher
+        regardless of breaker state — they ARE the recovery probe)."""
         model = self.models[name]
+        br = self.breakers.get(name)
         try:
+            if self.injector is not None:
+                self.injector.check("canary_fail", name)
+            if br is not None:
+                br.probe()
             item = model.canary_item()
             fut = self.batchers[name].submit(item, group=model.group_key(item))
             await asyncio.wait_for(fut, timeout=timeout)
@@ -161,7 +195,43 @@ class ServerState:
             *(self.run_canary(name, timeout=(timeouts or {}).get(name, timeout))
               for name in self.models))
 
+    # -- graceful drain ------------------------------------------------------
+    def begin_drain(self) -> None:
+        """Stop admitting requests: predict answers 503 + Retry-After and
+        /healthz flips so load balancers pull this replica."""
+        self.draining = True
+
+    async def drain(self) -> bool:
+        """SIGTERM path: refuse new work, then wait (<= drain_timeout_s) for
+        every accepted request to finish — a rolling restart drops zero
+        accepted requests. Returns False if the budget expired first."""
+        self.begin_drain()
+        # Early-retire deferred epochs so pending futures resolve in
+        # readback time instead of at the epoch deadline.
+        for rt in self.runtimes.values():
+            if hasattr(rt, "retire_active"):
+                rt.retire_active()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.cfg.drain_timeout_s
+        ok = True
+        for b in self.batchers.values():
+            ok &= await b.drain(deadline)
+        return ok
+
+    def shed_retry_after(self) -> int:
+        """Retry-After seconds for 429 shed / drain 503 responses."""
+        return max(1, math.ceil(self.cfg.shed_retry_after_s))
+
+    def breaker_retry_after(self, name: str) -> int:
+        """Retry-After seconds for breaker 503s: the canary interval when
+        periodic canaries drive recovery, else the model's configured hint."""
+        if self.cfg.canary_interval_s > 0:
+            return max(1, math.ceil(self.cfg.canary_interval_s))
+        br = self.breakers.get(name)
+        return max(1, math.ceil(br.retry_after_s if br else 1.0))
+
     async def stop(self) -> None:
+        await self.watchdog.stop()
         if self._canary_task is not None:
             self._canary_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
@@ -189,6 +259,17 @@ async def handle_predict(request: web.Request) -> web.Response:
     model = state.models.get(name)
     if model is None:
         return _err(404, f"unknown model {name!r}")
+    # Shed checks run BEFORE the body read: a draining replica or tripped
+    # model answers in microseconds, with a Retry-After hint, instead of
+    # paying decode + a doomed dispatch.
+    if state.draining:
+        return _err(503, "server draining; retry against another replica",
+                    retry_after=state.shed_retry_after())
+    breaker = state.breakers.get(name)
+    if breaker is not None and not breaker.allow():
+        breaker.on_shed()
+        return _err(503, f"circuit open for model {name!r}; recovery probe "
+                         "in progress", retry_after=state.breaker_retry_after(name))
     mcfg = state.cfg.model(name)
     metrics = state.metrics
     metrics.counter(f"requests_total{{model={name}}}").inc()
@@ -198,6 +279,8 @@ async def handle_predict(request: web.Request) -> web.Response:
     ctype = request.content_type or ""
 
     try:
+        if state.injector is not None:
+            state.injector.check("decode_corrupt", name)
         # (items, is_batch) with one parse; a 1-element client batch still
         # answers in the {"results": [...]} shape.
         if state.cfg.decode_inline:
@@ -220,7 +303,8 @@ async def handle_predict(request: web.Request) -> web.Response:
     except QueueFull:
         for f in futs:
             f.cancel()
-        return _err(429, "queue full, retry later")
+        return _err(429, "queue full, retry later",
+                    retry_after=state.shed_retry_after())
     except RuntimeError as e:
         # Batcher stopped/not started: requests racing shutdown get a clean
         # retryable status instead of an unhandled 500.
@@ -258,6 +342,9 @@ async def handle_models(request: web.Request) -> web.Response:
 
 async def handle_healthz(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
+    if state.draining:
+        return web.json_response(
+            {"status": "draining", "models": state.canary_ok}, status=503)
     ok = all(state.canary_ok.values()) if state.canary_ok else True
     return web.json_response(
         {"status": "ok" if ok else "degraded", "models": state.canary_ok},
@@ -276,6 +363,14 @@ async def handle_stats(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     out = state.metrics.summary()
     out["process"] = process_info()
+    # Shed/breaker state for operators (docs/ROBUSTNESS.md): what is tripped,
+    # what is draining, and what chaos is armed.
+    out["robustness"] = {
+        "draining": state.draining,
+        "breakers": {n: br.describe() for n, br in state.breakers.items()},
+    }
+    if state.injector is not None:
+        out["robustness"]["faults"] = state.injector.snapshot()
     return web.json_response(out)
 
 
@@ -333,8 +428,11 @@ async def handle_index(request: web.Request) -> web.Response:
     return web.Response(text=_INDEX_HTML, content_type="text/html")
 
 
-def _err(status: int, message: str) -> web.Response:
-    return web.json_response({"error": message}, status=status)
+def _err(status: int, message: str,
+         retry_after: int | None = None) -> web.Response:
+    headers = {"Retry-After": str(retry_after)} if retry_after else None
+    return web.json_response({"error": message}, status=status,
+                             headers=headers)
 
 
 # -- app wiring --------------------------------------------------------------
@@ -391,6 +489,50 @@ def configure_logging(cfg: ServerConfig) -> None:
             format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
 
+async def serve_async(state: ServerState,
+                      ready: asyncio.Event | None = None) -> None:
+    """Serve until SIGTERM/SIGINT, then drain gracefully.
+
+    Rolling restarts drop zero accepted requests: on signal the server (1)
+    stops admitting — predict answers 503 + Retry-After and /healthz flips
+    to "draining" so the load balancer pulls the replica; (2) flushes every
+    accepted request within ``drain_timeout_s``; (3) only then tears the
+    batchers/pools down (runner cleanup -> state.stop()).
+
+    ``ready`` (tests) is set once the listener is up and signal handlers are
+    installed; the bound addresses land in ``state.serving_addresses``."""
+    cfg = state.cfg
+    app = make_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.host, cfg.port)
+    await site.start()
+    state.serving_addresses = list(runner.addresses)
+    log.info("serving on %s", state.serving_addresses)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    installed: list[signal.Signals] = []
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+            installed.append(sig)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / platform without signal support
+    if ready is not None:
+        ready.set()
+    try:
+        await stop.wait()
+        log.info("shutdown signal: draining (budget %.0fs)", cfg.drain_timeout_s)
+        drained = await state.drain()
+        if not drained:
+            log.warning("drain budget expired with requests still in flight")
+    finally:
+        for sig in installed:
+            loop.remove_signal_handler(sig)
+        await runner.cleanup()  # on_cleanup -> state.stop()
+
+
 def serve(cfg: ServerConfig) -> None:
     """Blocking entry point: build models, compile, serve."""
     configure_logging(cfg)
@@ -401,5 +543,4 @@ def serve(cfg: ServerConfig) -> None:
     init_distributed(cfg.distributed)
     state = ServerState(cfg)
     state.build()
-    app = make_app(state)
-    web.run_app(app, host=cfg.host, port=cfg.port, access_log=None)
+    asyncio.run(serve_async(state))

@@ -1,0 +1,43 @@
+"""Version-compat shims for jax APIs that moved/renamed across releases.
+
+The image this repo targets floats across jax versions; serving code must
+not care. Current shims:
+
+- ``shard_map``: ``jax.shard_map`` (new) vs ``jax.experimental.shard_map``
+  (jax < 0.4.44), and the ``check_vma`` kwarg (new) vs its former name
+  ``check_rep``.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.4.44 keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_CHECK_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(*args, **kwargs):
+    """jax.shard_map with check_vma/check_rep renamed to whatever this jax
+    understands."""
+    if not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)
+
+
+def pcast_varying(x, axes):
+    """``jax.lax.pcast(x, axes, to="varying")`` where vma tracking exists;
+    identity on jax versions without it (replication-checking era, where
+    scan carry types never carried varying-axis annotations)."""
+    import jax
+
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axes, to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:  # intermediate releases: pvary only
+        return pvary(x, axes)
+    return x
