@@ -13,4 +13,10 @@ python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
 echo "== chaos suite (tests/test_faults.py, all tiers) =="
 python -m pytest tests/test_faults.py -q -p no:cacheprovider
 
+echo "== lifecycle suite (tests/test_lifecycle.py) =="
+python -m pytest tests/test_lifecycle.py -q -p no:cacheprovider
+
+echo "== reload drill (reload_corrupt @ 100%, availability >= 99%) =="
+scripts/reload_drill.sh
+
 echo "chaos smoke OK"

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Reload drill (ISSUE 2 acceptance bound): inject reload_corrupt at 100%,
+# hammer POST :reload throughout a CPU load run, and assert availability
+# stays >= 99% with the original model version still live (every reload
+# rejected at the integrity gate; no candidate ever published). Run by
+# scripts/chaos_smoke.sh and the CI workflow; see docs/ROBUSTNESS.md
+# "Model lifecycle & rollback".
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+cfg="$(mktemp -t reload_drill_cfg_XXXX)"
+out="$(mktemp -t reload_drill_out_XXXX)"
+trap 'rm -f "$cfg" "$out"' EXIT
+cat > "$cfg" <<'EOF'
+decode_threads = 2
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 5.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+
+[faults]
+enabled = true
+seed = 7
+
+[[faults.rule]]
+kind = "reload_corrupt"
+model = "toy"
+probability = 1.0
+EOF
+
+python -m tpuserve chaos --config "$cfg" --duration 5 --warmup 1 \
+    --concurrency 8 --drill reload --drill-interval 0.25 \
+    --min-availability 0.99 > "$out"
+
+python - "$out" <<'EOF'
+import json, sys
+
+s = json.load(open(sys.argv[1]))
+drill, lc = s["reload_drill"], s["lifecycle"]["toy"]
+assert drill["attempts"] > 0, s
+assert drill["ok"] == 0 and drill["rolled_back"] == 0, drill
+assert lc["live_version"] == 1, lc
+assert all(h["status"] in ("live", "rejected") for h in lc["history"]), lc
+print(f"reload drill OK: availability={s['availability']} "
+      f"reloads attempted={drill['attempts']} rejected={drill['rejected']} "
+      f"live_version={lc['live_version']}")
+EOF

@@ -213,3 +213,57 @@ def test_cancelled_requests_skipped(rt_model):
         await b.stop()
 
     run(go())
+
+
+def test_deadline_expired_in_queue_fails_fast(rt_model):
+    """P3 discipline: a request whose per-request deadline passes while it
+    waits behind slow in-flight work fails AT its deadline with
+    DeadlineExceeded — never dispatched — while undeadlined work survives."""
+    import time
+
+    from tpuserve.batcher import DeadlineExceeded
+
+    async def go():
+        model, _ = rt_model
+        b, metrics = make_batcher(rt_model, deadline_ms=20.0, max_inflight=1)
+        await b.start()
+        try:
+            # One-shot 400 ms dispatch stall occupies the single slot.
+            b.injector = FaultInjector.single("slow_dispatch",
+                                              delay_ms=400.0, count=1)
+            slow = b.submit(item())
+            await asyncio.sleep(0.05)  # dispatched, slot held
+            t0 = time.perf_counter()
+            doomed = b.submit(item(), deadline_at=t0 + 0.05)
+            with pytest.raises(DeadlineExceeded, match="deadline expired"):
+                await asyncio.wait_for(doomed, timeout=10)
+            waited = time.perf_counter() - t0
+            assert waited < 0.3, waited  # failed AT the deadline, not at slot free
+            assert metrics.counter(
+                "deadline_exceeded_total{model=toy}").value == 1
+            assert "top_k" in await asyncio.wait_for(slow, timeout=10)
+            # Queue drained cleanly: later requests still serve.
+            res = await asyncio.wait_for(b.submit(item()), timeout=10)
+            assert "top_k" in res
+            assert b._pending == 0
+        finally:
+            await b.stop()
+            model.cfg.max_inflight = 2  # module-scoped cfg: restore default
+
+    run(go())
+
+
+def test_generous_deadline_dispatches_normally(rt_model):
+    async def go():
+        b, metrics = make_batcher(rt_model, deadline_ms=20.0)
+        await b.start()
+        import time
+
+        fut = b.submit(item(), deadline_at=time.perf_counter() + 30.0)
+        res = await asyncio.wait_for(fut, timeout=10)
+        assert "top_k" in res
+        assert metrics.counter(
+            "deadline_exceeded_total{model=toy}").value == 0
+        await b.stop()
+
+    run(go())

@@ -198,6 +198,44 @@ def test_availability_with_10pct_batch_failures(loop):
     assert summary["breakers"]["toy"]["opened_total"] == 0
 
 
+def test_reload_drill_availability(loop):
+    """The ISSUE 2 acceptance bound: with reload_corrupt injected at 100%
+    and :reload hammered throughout the run, every reload is rejected at
+    the integrity gate, the original version keeps serving, and
+    availability stays >= 99%."""
+    cfg = toy_server_cfg(faults=FaultsConfig(enabled=True, seed=3, rules=[
+        FaultRuleConfig(kind="reload_corrupt", model="toy")]))
+    state = ServerState(cfg)
+    state.build()
+    summary = loop.run_until_complete(run_chaos(
+        state, "toy", duration_s=1.5, warmup_s=0.3, concurrency=8, edge=8,
+        drill="reload", drill_interval_s=0.1))
+    assert summary["n_ok"] > 100, summary
+    assert summary["availability"] >= 0.99, summary
+    drill = summary["reload_drill"]
+    assert drill["attempts"] >= 5, drill  # the drill actually hammered
+    assert drill["ok"] == 0 and drill["rolled_back"] == 0
+    assert drill["rejected"] == drill["attempts"] - drill["errors"]
+    # The original version never left service; no candidate ever published.
+    lc = summary["lifecycle"]["toy"]
+    assert lc["live_version"] == 1
+    assert all(h["status"] in ("live", "rejected") for h in lc["history"])
+
+
+def test_reload_nan_drill_keeps_serving(loop):
+    """Same bound for the NaN gate (reload_nan at 100%)."""
+    cfg = toy_server_cfg(faults=FaultsConfig(enabled=True, seed=4, rules=[
+        FaultRuleConfig(kind="reload_nan", model="toy")]))
+    state = ServerState(cfg)
+    state.build()
+    summary = loop.run_until_complete(run_chaos(
+        state, "toy", duration_s=1.0, warmup_s=0.2, concurrency=8, edge=8,
+        drill="reload", drill_interval_s=0.1))
+    assert summary["availability"] >= 0.99, summary
+    assert summary["lifecycle"]["toy"]["live_version"] == 1
+    assert summary["reload_drill"]["ok"] == 0
+
+
 # ---------------------------------------------------------------------------
 # Circuit breaker over HTTP: fast 503 + Retry-After, canary-driven recovery
 # ---------------------------------------------------------------------------

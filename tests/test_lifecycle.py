@@ -1,0 +1,498 @@
+"""Versioned model lifecycle (ISSUE 2): integrity-checked, canary-gated
+weight hot-swap with automatic rollback, plus per-request deadlines.
+
+Everything runs on CPU with the toy family against real aiohttp servers.
+The invariants under test are the state-path counterparts of PR 1's
+request-path guarantees: a bad candidate (corrupt / NaN / regressed) never
+answers one request, the old version keeps serving through every rejection,
+and rollback restores version N-1 exactly.
+"""
+
+import asyncio
+import io
+import json
+import shutil
+import time
+
+import jax
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpuserve.config import (FaultRuleConfig, FaultsConfig, LifecycleConfig,
+                             ModelConfig, ServerConfig)
+from tpuserve.faults import FaultInjector
+from tpuserve.models import build
+from tpuserve.runtime import NaNDetected, build_runtime
+from tpuserve.savedmodel import (IntegrityError, manifest_path, save_orbax,
+                                 tree_digests, verify_manifest_if_present,
+                                 write_manifest)
+from tpuserve.server import ServerState, make_app
+
+NPY = {"Content-Type": "application/x-npy"}
+
+
+def toy_model_cfg(**over) -> ModelConfig:
+    base = dict(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                deadline_ms=5.0, dtype="float32", num_classes=10,
+                parallelism="single", request_timeout_ms=10_000.0)
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def toy_server_cfg(model_over=None, **over) -> ServerConfig:
+    base = dict(models=[toy_model_cfg(**(model_over or {}))], decode_threads=2)
+    base.update(over)
+    return ServerConfig(**base)
+
+
+def npy_image(seed: int = 0) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.random.default_rng(seed).integers(
+        0, 200, (8, 8, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+def toy_params(key: int = 1):
+    return build(toy_model_cfg()).init_params(jax.random.key(key))
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+async def _serving_client(state):
+    client = TestClient(TestServer(make_app(state)))
+    await client.start_server()
+    return client
+
+
+async def _probs(client) -> list:
+    """Top-k probs for a fixed input: the weight-identity fingerprint."""
+    r = await client.post("/v1/models/toy:predict", data=npy_image(7),
+                          headers=NPY)
+    assert r.status == 200, await r.text()
+    return [e["prob"] for e in (await r.json())["top_k"]]
+
+
+# ---------------------------------------------------------------------------
+# Sidecar checksum manifest
+# ---------------------------------------------------------------------------
+
+def test_save_orbax_writes_manifest_and_verifies(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    params = toy_params()
+    save_orbax(ckpt, params)
+    import os
+    assert os.path.exists(manifest_path(ckpt))
+    assert verify_manifest_if_present(ckpt, jax.device_get(params)) is True
+    # Any flipped leaf fails the digest comparison.
+    bad = jax.device_get(params)
+    bad["w1"] = np.asarray(bad["w1"]).copy()
+    bad["w1"][0, 0] += 1.0
+    with pytest.raises(IntegrityError, match="corrupt"):
+        verify_manifest_if_present(ckpt, bad)
+
+
+def test_manifest_missing_skips_unless_required(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    params = jax.device_get(toy_params())
+    save_orbax(ckpt, params)
+    import os
+    os.remove(manifest_path(ckpt))
+    assert verify_manifest_if_present(ckpt, params) is False  # skipped
+    with pytest.raises(IntegrityError, match="require_manifest"):
+        verify_manifest_if_present(ckpt, params, require=True)
+
+
+def test_tree_digests_stable_and_sensitive():
+    params = jax.device_get(toy_params())
+    a, b = tree_digests(params), tree_digests(params)
+    assert a == b
+    changed = dict(params, b1=np.asarray(params["b1"]) + 1)
+    assert tree_digests(changed) != a
+
+
+# ---------------------------------------------------------------------------
+# Runtime version bookkeeping
+# ---------------------------------------------------------------------------
+
+def test_runtime_versions_monotonic_and_rollback():
+    model = build(toy_model_cfg())
+    rt = build_runtime(model)
+    assert rt.version == 1
+    rt.publish(rt.stage_params())
+    assert rt.version == 2
+    rt.publish(rt.stage_params())
+    assert rt.version == 3
+    info = rt.rollback()
+    assert info == {"model": "toy", "version": 2, "rolled_back_from": 3}
+    with pytest.raises(ValueError, match="no retained previous"):
+        rt.rollback()
+    # Version numbers are never reused after a rollback.
+    rt.publish(rt.stage_params())
+    assert rt.version == 4
+
+
+def test_stage_params_rejects_nan_tree():
+    model = build(toy_model_cfg())
+    rt = build_runtime(model)
+    good = model.load_params
+    poisoned = jax.device_get(model.init_params(jax.random.key(0)))
+    poisoned["w2"] = np.asarray(poisoned["w2"]).copy()
+    poisoned["w2"][3, 3] = np.nan
+    model.load_params = lambda: poisoned
+    try:
+        with pytest.raises(NaNDetected, match="NaN/Inf"):
+            rt.stage_params()
+    finally:
+        model.load_params = good
+    assert rt.version == 1  # nothing published
+
+
+# ---------------------------------------------------------------------------
+# HTTP: rejection gates keep the old version serving
+# ---------------------------------------------------------------------------
+
+def test_checksum_mismatch_rejected_old_version_serves(tmp_path, loop):
+    """Overwrite the checkpoint but keep the stale manifest (bit-rot /
+    torn-copy stand-in): the reload 409s at the integrity gate and the
+    in-memory version keeps serving identical outputs."""
+    ckpt = str(tmp_path / "ckpt")
+    save_orbax(ckpt, toy_params(1))
+    with open(manifest_path(ckpt), encoding="utf-8") as f:
+        stale_manifest = f.read()
+    state = ServerState(toy_server_cfg(model_over=dict(weights=ckpt)))
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            before = await _probs(client)
+            shutil.rmtree(ckpt)
+            save_orbax(ckpt, toy_params(2))
+            with open(manifest_path(ckpt), "w", encoding="utf-8") as f:
+                f.write(stale_manifest)
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 409, await r.text()
+            body = await r.json()
+            assert body["stage"] == "integrity"
+            assert body["rolled_back"] is False
+            assert body["version"] == 1
+            assert await _probs(client) == before  # old weights untouched
+            stats = await (await client.get("/stats")).json()
+            assert stats["lifecycle"]["toy"]["live_version"] == 1
+            assert stats["counters"][
+                "reload_rejected_total{model=toy,stage=integrity}"] == 1
+            assert stats["gauges"]["model_version{model=toy}"] == 1.0
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_nan_checkpoint_rejected_old_version_serves(tmp_path, loop):
+    ckpt = str(tmp_path / "ckpt")
+    save_orbax(ckpt, toy_params(1))
+    state = ServerState(toy_server_cfg(model_over=dict(weights=ckpt)))
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            before = await _probs(client)
+            poisoned = jax.device_get(toy_params(2))
+            poisoned["w1"] = np.asarray(poisoned["w1"]).copy()
+            poisoned["w1"][0, 0] = np.inf
+            shutil.rmtree(ckpt)
+            save_orbax(ckpt, poisoned)  # manifest matches: integrity passes
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 409, await r.text()
+            body = await r.json()
+            assert body["stage"] == "nan_scan" and body["version"] == 1
+            assert await _probs(client) == before
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_staged_canary_failure_never_publishes(loop):
+    """reload_regressed injected at 100%: the staged canary fails, the
+    candidate never publishes, and zero requests are answered by it."""
+    cfg = toy_server_cfg(faults=FaultsConfig(enabled=True, rules=[
+        FaultRuleConfig(kind="reload_regressed", model="toy")]))
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            before = await _probs(client)
+            for _ in range(3):
+                r = await client.post("/admin/models/toy:reload")
+                assert r.status == 409, await r.text()
+                body = await r.json()
+                assert body["stage"] == "staged_canary"
+                assert body["version"] == 1
+                assert await _probs(client) == before
+            stats = await (await client.get("/stats")).json()
+            assert stats["counters"][
+                "reload_rejected_total{model=toy,stage=staged_canary}"] == 3
+            history = stats["lifecycle"]["toy"]["history"]
+            assert [h["status"] for h in history] == \
+                ["live", "rejected", "rejected", "rejected"]
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_post_publish_canary_failure_rolls_back(loop):
+    """The PR-1 hole, closed: a canary failure after publish no longer
+    answers 200 with bad weights live — the lifecycle rolls back and the
+    response says so."""
+    cfg = toy_server_cfg(faults=FaultsConfig(enabled=True, rules=[
+        FaultRuleConfig(kind="canary_fail", model="toy")]))
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 500, await r.text()
+            body = await r.json()
+            assert body["stage"] == "post_canary"
+            assert body["rolled_back"] is True
+            assert body["version"] == 1  # back on the last known good
+            stats = await (await client.get("/stats")).json()
+            assert stats["counters"][
+                "rollbacks_total{model=toy,reason=post_publish_canary}"] == 1
+            assert stats["gauges"]["model_version{model=toy}"] == 1.0
+            # Serving never stopped.
+            ok = await client.post("/v1/models/toy:predict",
+                                   data=npy_image(), headers=NPY)
+            assert ok.status == 200
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# Rollback endpoint + soak window
+# ---------------------------------------------------------------------------
+
+def test_rollback_endpoint_restores_previous_version(tmp_path, loop):
+    ckpt = str(tmp_path / "ckpt")
+    params_a = jax.device_get(toy_params(1))
+    save_orbax(ckpt, params_a)
+    state = ServerState(toy_server_cfg(model_over=dict(weights=ckpt)))
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            probs_a = await _probs(client)
+            params_b = jax.tree_util.tree_map(lambda x: x + 0.25, params_a)
+            shutil.rmtree(ckpt)
+            save_orbax(ckpt, params_b)
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 200, await r.text()
+            assert (await r.json())["version"] == 2
+            probs_b = await _probs(client)
+            assert probs_b != probs_a  # genuinely new weights
+            r = await client.post("/admin/models/toy:rollback")
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["version"] == 1 and body["rolled_back_from"] == 2
+            assert await _probs(client) == probs_a  # bit-identical restore
+            v = await (await client.get("/admin/models/toy/versions")).json()
+            assert v["live_version"] == 1
+            assert v["previous_version"] is None
+            assert [h["status"] for h in v["history"]][-1] == "live"
+            # Nothing retained anymore: second rollback conflicts.
+            r = await client.post("/admin/models/toy:rollback")
+            assert r.status == 409
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_breaker_trip_in_soak_window_auto_rolls_back(loop):
+    cfg = toy_server_cfg(
+        model_over=dict(breaker_threshold=2),
+        lifecycle=LifecycleConfig(soak_s=5.0, soak_poll_s=0.05))
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["version"] == 2 and body["soak_s"] == 5.0
+            v = await (await client.get("/admin/models/toy/versions")).json()
+            assert v["soaking"] is True
+
+            # Total outage below the HTTP layer: dispatches fail, the
+            # breaker trips, and the soak monitor must revert to v1.
+            state.batchers["toy"].injector = FaultInjector.single("batch_error")
+            for _ in range(2):
+                bad = await client.post("/v1/models/toy:predict",
+                                        data=npy_image(), headers=NPY)
+                assert bad.status == 500
+            assert state.breakers["toy"].state == "open"
+            deadline = time.perf_counter() + 3.0
+            while time.perf_counter() < deadline:
+                if state.runtimes["toy"].version == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert state.runtimes["toy"].version == 1, "soak did not roll back"
+            state.batchers["toy"].injector = None
+            stats = await (await client.get("/stats")).json()
+            assert stats["counters"][
+                "rollbacks_total{model=toy,reason=soak_breaker}"] == 1
+            assert stats["lifecycle"]["toy"]["soaking"] is False
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_soak_window_passes_quietly(loop):
+    """A healthy reload with a short soak window stays on the new version."""
+    cfg = toy_server_cfg(lifecycle=LifecycleConfig(soak_s=0.2,
+                                                   soak_poll_s=0.05))
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 200
+            await asyncio.sleep(0.4)  # outlive the soak window
+            v = await (await client.get("/admin/models/toy/versions")).json()
+            assert v["live_version"] == 2 and v["soaking"] is False
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# Reload under load: zero accepted requests dropped
+# ---------------------------------------------------------------------------
+
+def test_reload_under_load_drops_nothing(tmp_path, loop):
+    ckpt = str(tmp_path / "ckpt")
+    params_a = jax.device_get(toy_params(1))
+    save_orbax(ckpt, params_a)
+    state = ServerState(toy_server_cfg(model_over=dict(weights=ckpt)))
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            async def one(i: int) -> int:
+                r = await client.post("/v1/models/toy:predict",
+                                      data=npy_image(i), headers=NPY)
+                return r.status
+
+            first = [asyncio.ensure_future(one(i)) for i in range(24)]
+            shutil.rmtree(ckpt)
+            save_orbax(ckpt, jax.tree_util.tree_map(lambda x: x + 0.25,
+                                                    params_a))
+            reload_task = asyncio.ensure_future(
+                client.post("/admin/models/toy:reload"))
+            second = [asyncio.ensure_future(one(100 + i)) for i in range(24)]
+            statuses = await asyncio.gather(*first, *second)
+            assert statuses == [200] * 48  # zero dropped, zero errored
+            assert (await reload_task).status == 200
+            assert state.runtimes["toy"].version == 2
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# Per-request deadlines over HTTP (P3)
+# ---------------------------------------------------------------------------
+
+def test_timeout_ms_expired_in_queue_fast_504(loop):
+    """A queued request whose client deadline expires behind a slow batch
+    gets the batcher's fast deadline_exceeded 504, counted as such."""
+    cfg = toy_server_cfg(
+        model_over=dict(max_inflight=1),
+        # No startup canary: it would consume the one-shot slow_dispatch.
+        startup_canary=False,
+        faults=FaultsConfig(enabled=True, rules=[
+            FaultRuleConfig(kind="slow_dispatch", delay_ms=400.0, count=1)]))
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            slow = asyncio.ensure_future(client.post(
+                "/v1/models/toy:predict", data=npy_image(), headers=NPY))
+            await asyncio.sleep(0.1)  # dispatched, holding the inflight slot
+            r = await client.post("/v1/models/toy:predict?timeout_ms=50",
+                                  data=npy_image(), headers=NPY)
+            assert r.status == 504, await r.text()
+            assert "deadline" in (await r.json())["error"]
+            assert (await slow).status == 200  # the slow batch still lands
+            stats = await (await client.get("/stats")).json()
+            assert stats["counters"]["deadline_exceeded_total{model=toy}"] >= 1
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_timeout_ms_accepted_from_json_body(loop):
+    """JSON bodies can carry timeout_ms without breaking model decode; an
+    ample deadline serves normally."""
+    from tpuserve.server import _requested_timeout_ms
+
+    class Req:
+        query: dict = {}
+        headers: dict = {}
+
+    body = json.dumps({"text": "hi", "timeout_ms": 1234.0}).encode()
+    assert _requested_timeout_ms(Req(), body, "application/json") == 1234.0
+    assert _requested_timeout_ms(Req(), b'{"text": "hi"}',
+                                 "application/json") is None
+    assert _requested_timeout_ms(Req(), b"\x93NUMPY...",
+                                 "application/x-npy") is None
+    with pytest.raises(ValueError, match="positive"):
+        _requested_timeout_ms(Req(), json.dumps({"timeout_ms": -5}).encode(),
+                              "application/json")
+
+
+def test_timeout_ms_rejected_when_malformed(loop):
+    state = ServerState(toy_server_cfg())
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            r = await client.post("/v1/models/toy:predict?timeout_ms=nope",
+                                  data=npy_image(), headers=NPY)
+            assert r.status == 400
+            assert "timeout_ms" in (await r.json())["error"]
+            ok = await client.post("/v1/models/toy:predict?timeout_ms=5000",
+                                   data=npy_image(), headers=NPY)
+            assert ok.status == 200
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())

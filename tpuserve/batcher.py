@@ -24,7 +24,9 @@ then do futures carry the error. Dispatch outcomes feed the per-model
 circuit breaker, an optional FaultInjector supplies deterministic chaos at
 the dispatch call sites, and dead group tasks are revived by the server
 watchdog (``revive_group_loops``). Client disconnects cancel futures, which
-are dropped at flush time.
+are dropped at flush time. Requests carrying a per-request deadline
+(``timeout_ms``) that expires while queued fail fast with DeadlineExceeded
+at flush time — rejected in microseconds, not computed for nobody (P3).
 """
 
 from __future__ import annotations
@@ -47,12 +49,21 @@ class QueueFull(Exception):
     """Raised by submit() when the model queue is at capacity (-> HTTP 429)."""
 
 
+class DeadlineExceeded(Exception):
+    """A request's absolute deadline expired while it was still queued
+    (-> fast HTTP 504). Clockwork discipline (PAPERS.md P3): work nobody is
+    waiting for is rejected before dispatch, not computed and discarded."""
+
+
 @dataclass
 class _Request:
     item: Any  # decoded input (np arrays), model-specific
     group: Hashable
     future: asyncio.Future = field(repr=False)
     enqueued_at: float = 0.0  # time.perf_counter()
+    # Absolute per-request deadline (perf_counter clock), stamped at
+    # admission from the client's timeout_ms; None = model default only.
+    deadline_at: float | None = None
 
 
 class ModelBatcher:
@@ -118,8 +129,13 @@ class ModelBatcher:
             await asyncio.gather(*self._dispatch_tasks, return_exceptions=True)
 
     # -- submission (event loop) --------------------------------------------
-    def submit(self, item: Any, group: Hashable = None) -> asyncio.Future:
-        """Enqueue one decoded request; returns a Future of its result."""
+    def submit(self, item: Any, group: Hashable = None,
+               deadline_at: float | None = None) -> asyncio.Future:
+        """Enqueue one decoded request; returns a Future of its result.
+
+        ``deadline_at`` (perf_counter clock) is the request's absolute
+        deadline: if it expires while the request is still queued, the
+        future fails with DeadlineExceeded instead of dispatching."""
         if not self._running or self._inflight is None:
             raise RuntimeError(f"batcher for {self.model.name} not started")
         if self._pending >= self.cfg.max_queue:
@@ -127,7 +143,8 @@ class ModelBatcher:
             raise QueueFull(self.model.name)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        req = _Request(item=item, group=group, future=fut, enqueued_at=time.perf_counter())
+        req = _Request(item=item, group=group, future=fut,
+                       enqueued_at=time.perf_counter(), deadline_at=deadline_at)
         q = self._queues.get(group)
         if q is None:
             q = self._queues[group] = asyncio.Queue()
@@ -172,6 +189,39 @@ class ModelBatcher:
             await asyncio.sleep(0.02)
         return self._pending == 0 and not self._dispatch_tasks
 
+    def _expire_dead(self, reqs: list[_Request],
+                     adjust_pending: bool) -> list[_Request]:
+        """Fail requests whose per-request deadline has passed (-> fast 504,
+        ``deadline_exceeded_total``) and drop already-done futures; returns
+        the still-live rest. ``adjust_pending`` settles the queue-depth
+        accounting for dropped requests when the batch-wide decrement has
+        not run yet (the slot-wait call sites)."""
+        now = time.perf_counter()
+        live: list[_Request] = []
+        n_expired = 0
+        for r in reqs:
+            if r.future.done():  # cancelled while queued (client gone)
+                if adjust_pending:
+                    self._pending -= 1
+                continue
+            if r.deadline_at is not None and now >= r.deadline_at:
+                r.future.set_exception(DeadlineExceeded(
+                    f"deadline expired after "
+                    f"{(now - r.enqueued_at) * 1e3:.0f} ms in queue"))
+                n_expired += 1
+                if adjust_pending:
+                    self._pending -= 1
+                continue
+            live.append(r)
+        if n_expired:
+            self.metrics.counter(
+                f"deadline_exceeded_total{{model={self.model.name}}}"
+            ).inc(n_expired)
+        if adjust_pending and len(live) != len(reqs):
+            self.metrics.gauge(
+                f"queue_depth{{model={self.model.name}}}").set(self._pending)
+        return live
+
     # -- accumulation (event loop) ------------------------------------------
     async def _group_loop(self, group: Hashable, q: asyncio.Queue) -> None:
         max_bucket = max(self.cfg.batch_buckets)
@@ -195,7 +245,29 @@ class ModelBatcher:
                         break
                 # Backpressure: the semaphore bounds in-flight device batches;
                 # the group task itself waits here, which pipelines dispatch.
-                await self._inflight.acquire()
+                # The wait is bounded by the earliest per-request deadline in
+                # the batch (P3): a request that dies behind slow in-flight
+                # work fails fast AT its deadline, instead of being
+                # discovered dead only when a slot finally frees.
+                batch = self._expire_dead(batch, adjust_pending=True)
+                while batch:
+                    earliest = min((r.deadline_at for r in batch
+                                    if r.deadline_at is not None),
+                                   default=None)
+                    if earliest is None:
+                        await self._inflight.acquire()
+                        break
+                    slot_wait = earliest - time.perf_counter()
+                    if slot_wait > 0:
+                        try:
+                            await asyncio.wait_for(self._inflight.acquire(),
+                                                   slot_wait)
+                            break
+                        except asyncio.TimeoutError:
+                            pass
+                    batch = self._expire_dead(batch, adjust_pending=True)
+                if not batch:
+                    continue  # everything expired; no slot was acquired
             except asyncio.CancelledError:
                 # stop() cancelled us mid-accumulation: requests already
                 # pulled off the queue must fail, not hang their clients.
@@ -215,6 +287,10 @@ class ModelBatcher:
             self._pending -= len(batch)
             self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
             live = [r for r in batch if not r.future.cancelled()]
+            # Last deadline check at flush: requests drained from the queue
+            # above may have expired too. Their pending count was already
+            # settled in the batch-wide decrement.
+            live = self._expire_dead(live, adjust_pending=False)
             if not live:
                 self._inflight.release()
                 continue

@@ -4,7 +4,8 @@ Usage::
 
     python -m tpuserve serve  --config serve.toml [--set port=9000 ...]
     python -m tpuserve bench  --url http://127.0.0.1:8000 --model resnet50 ...
-    python -m tpuserve chaos  --config chaos.toml --min-availability 0.99
+    python -m tpuserve chaos  --config chaos.toml --min-availability 0.99 \
+                              [--drill reload]
     python -m tpuserve import-model --saved-model DIR --family resnet50 --out CKPT
     python -m tpuserve warmup --config serve.toml   (compile + persist XLA cache)
     python -m tpuserve describe                      (device/mesh inventory)
@@ -102,6 +103,13 @@ def main(argv: list[str] | None = None) -> int:
                          help="open-loop offered rate (req/s); default closed loop")
     p_chaos.add_argument("--min-availability", type=float, default=0.0,
                          help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
+    p_chaos.add_argument("--drill", choices=["reload"], default=None,
+                         help="additionally drive an admin drill during the "
+                              "run: 'reload' POSTs :reload on an interval so "
+                              "reload_* fault rules prove the lifecycle "
+                              "gates hold availability")
+    p_chaos.add_argument("--drill-interval", type=float, default=0.5,
+                         help="seconds between drill operations")
 
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
@@ -147,7 +155,8 @@ def main(argv: list[str] | None = None) -> int:
         summary = asyncio.run(run_chaos(
             state, model, duration_s=args.duration, warmup_s=args.warmup,
             concurrency=args.concurrency, rate_per_s=args.rate,
-            edge=cfg.model(model).wire_size))
+            edge=cfg.model(model).wire_size, drill=args.drill,
+            drill_interval_s=args.drill_interval))
         print(json.dumps(summary, indent=2))
         return 0 if summary["availability"] >= args.min_availability else 1
 

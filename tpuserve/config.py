@@ -39,6 +39,9 @@ FAULT_KINDS = (
     "device_error",     # raise inside ModelRuntime.run (below the batcher)
     "slow_compute",     # sleep delay_ms inside ModelRuntime.run
     "kill_group_loop",  # crash the group accumulation task (watchdog food)
+    "reload_corrupt",   # fail the reload integrity check (checksum mismatch)
+    "reload_nan",       # fail the reload NaN/Inf scan (poisoned checkpoint)
+    "reload_regressed", # fail the staged canary (regressed weights)
 )
 
 
@@ -79,6 +82,36 @@ class FaultsConfig:
     # Base seed rule-local RNGs derive from (reproducible chaos runs).
     seed: int = 0
     rules: list[FaultRuleConfig] = field(default_factory=list)
+
+
+@dataclass
+class LifecycleConfig:
+    """Versioned model lifecycle (``[lifecycle]`` TOML; tpuserve.lifecycle).
+
+    Every weight reload is a staged, reversible transition: load off the
+    serving path -> verify integrity -> canary the *staged* params -> publish
+    as a numbered version with the previous tree retained -> auto-rollback on
+    post-publish canary failure or a breaker trip within the soak window."""
+
+    # Verify the sidecar checksum manifest (written by save_orbax /
+    # import-model) against the loaded tree when one is present.
+    verify_checksum: bool = True
+    # Reject reloads of orbax checkpoints that carry NO manifest (strict
+    # provenance mode). Off by default: TF/torch imports have no manifest.
+    require_manifest: bool = False
+    # Scan the candidate tree for NaN/Inf float leaves before staging.
+    nan_scan: bool = True
+    # Run the canary inference against the STAGED params (via the runtime's
+    # params-override hook) before publishing; a failure never publishes.
+    staged_canary: bool = True
+    # Post-publish soak window (s): if the model's circuit breaker trips or
+    # the periodic canary fails within this window, the reload auto-rolls
+    # back to the retained last-known-good version. 0 disables soaking.
+    soak_s: float = 0.0
+    # Soak poll cadence (s).
+    soak_poll_s: float = 0.25
+    # Version-transition records kept per model (/admin .../versions).
+    history_limit: int = 16
 
 
 @dataclass
@@ -254,6 +287,8 @@ class ServerConfig:
     log_json: bool = False
     # Deterministic fault injection (chaos testing); disabled by default.
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    # Versioned reload lifecycle (integrity checks, staged canary, rollback).
+    lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
     # Watchdog sweep interval: restart dead group-accumulation tasks and reap
     # dead deferred workers every this many seconds (0 disables).
     watchdog_interval_s: float = 1.0
@@ -294,10 +329,13 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
     faults_dict = raw.pop("faults", None)
+    lifecycle_dict = raw.pop("lifecycle", None)
     cfg: ServerConfig = _build(ServerConfig, raw)
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
     if dist_dict is not None:
         cfg.distributed = _build(DistributedConfig, dist_dict)
+    if lifecycle_dict is not None:
+        cfg.lifecycle = _build(LifecycleConfig, lifecycle_dict)
     if faults_dict is not None:
         rule_dicts = faults_dict.pop("rule", [])
         cfg.faults = _build(FaultsConfig, faults_dict)

@@ -10,8 +10,11 @@ holds both sides of that story:
   site on the serving path — plus model / probability / count, and draw from
   rule-local seeded RNGs so a chaos run replays exactly. Call sites live in
   the batcher (batch_error, slow_dispatch, kill_group_loop), the runtime
-  (device_error, slow_compute), the deferred pool (worker_death), and the
-  server (decode_corrupt, canary_fail).
+  (device_error, slow_compute), the deferred pool (worker_death), the
+  server (decode_corrupt, canary_fail), and the reload lifecycle
+  (reload_corrupt / reload_nan at the staging gates in
+  ModelRuntime.stage_params, reload_regressed at the staged canary in
+  tpuserve.lifecycle — drill them with ``tpuserve chaos --drill reload``).
 
 - **CircuitBreaker**: per-model, trips to fast 503 + ``Retry-After`` after N
   consecutive failed dispatches; half-opens via the existing canary path
@@ -292,13 +295,21 @@ class Watchdog:
 async def run_chaos(state, model_name: str, duration_s: float = 10.0,
                     warmup_s: float = 1.0, concurrency: int = 16,
                     rate_per_s: float | None = None, verb: str = "predict",
-                    edge: int = 256) -> dict:
+                    edge: int = 256, drill: str | None = None,
+                    drill_interval_s: float = 0.5) -> dict:
     """Serve ``state`` on an ephemeral local port, drive the load generator
     at one model, and report availability + per-rule injection counts.
 
     The server must be built (``state.build()``) but not started; this owns
     its lifecycle. Intended for staging chaos drills: arm ``[faults]`` rules
-    in the config and assert the availability number here, not in prod."""
+    in the config and assert the availability number here, not in prod.
+
+    ``drill="reload"`` additionally hammers ``:reload`` every
+    ``drill_interval_s`` throughout the run — with ``reload_corrupt`` /
+    ``reload_nan`` / ``reload_regressed`` rules armed this proves the
+    lifecycle gates hold availability while every reload is failing; the
+    summary carries the reload outcomes and final lifecycle state."""
+    import aiohttp
     from aiohttp import web
 
     from tpuserve.bench.loadgen import run_load, run_load_open, synthetic_image_npy
@@ -309,10 +320,38 @@ async def run_chaos(state, model_name: str, duration_s: float = 10.0,
     await runner.setup()
     site = web.TCPSite(runner, "127.0.0.1", 0)
     await site.start()
+    drill_task = None
+    reload_stats = {"attempts": 0, "ok": 0, "rejected": 0, "rolled_back": 0,
+                    "errors": 0}
+
+    async def reload_driller(base: str) -> None:
+        async with aiohttp.ClientSession() as session:
+            while True:
+                await asyncio.sleep(drill_interval_s)
+                reload_stats["attempts"] += 1
+                try:
+                    async with session.post(
+                            f"{base}/admin/models/{model_name}:reload") as r:
+                        body = await r.json()
+                        if r.status == 200:
+                            reload_stats["ok"] += 1
+                        elif body.get("rolled_back"):
+                            reload_stats["rolled_back"] += 1
+                        else:
+                            reload_stats["rejected"] += 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — drill races teardown
+                    reload_stats["errors"] += 1
+
     try:
         port = runner.addresses[0][1]
-        url = f"http://127.0.0.1:{port}/v1/models/{model_name}:{verb}"
+        base = f"http://127.0.0.1:{port}"
+        url = f"{base}/v1/models/{model_name}:{verb}"
         payload = synthetic_image_npy(edge=edge)
+        if drill == "reload":
+            drill_task = asyncio.get_running_loop().create_task(
+                reload_driller(base))
         if rate_per_s:
             result = await run_load_open(url, payload, "application/x-npy",
                                          rate_per_s, duration_s, warmup_s)
@@ -320,6 +359,15 @@ async def run_chaos(state, model_name: str, duration_s: float = 10.0,
             result = await run_load(url, payload, "application/x-npy",
                                     duration_s, concurrency, warmup_s)
     finally:
+        if drill_task is not None:
+            drill_task.cancel()
+            try:
+                await drill_task
+            except asyncio.CancelledError:
+                pass
+        # Snapshot lifecycle state BEFORE cleanup tears the server down.
+        lifecycle_out = {n: lc.describe()
+                         for n, lc in state.lifecycles.items()}
         await runner.cleanup()
     out = result.summary()
     total = result.n_ok + result.n_err
@@ -327,4 +375,8 @@ async def run_chaos(state, model_name: str, duration_s: float = 10.0,
     if state.injector is not None:
         out["faults"] = state.injector.snapshot()
     out["breakers"] = {n: br.describe() for n, br in state.breakers.items()}
+    if lifecycle_out:
+        out["lifecycle"] = lifecycle_out
+    if drill is not None:
+        out["reload_drill"] = reload_stats
     return out

@@ -158,6 +158,17 @@ PHASES = ("queue", "preproc", "h2d", "compute", "postproc", "total")
 # so "bigger = less healthy" reads naturally on a dashboard.
 BREAKER_STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 
+# Lifecycle reload gates, in pipeline order (tpuserve.lifecycle): the stage
+# label on reload_rejected_total{model=,stage=}. "post_canary" is the only
+# one that implies a rollback happened (the candidate had published).
+RELOAD_STAGES = ("integrity", "nan_scan", "structure", "load",
+                 "staged_canary", "post_canary")
+
+# Reasons on rollbacks_total{model=,reason=}: the explicit admin endpoint,
+# a failed post-publish canary, and the two soak-window triggers.
+ROLLBACK_REASONS = ("manual", "post_publish_canary", "soak_breaker",
+                    "soak_canary")
+
 
 class Metrics:
     """Registry of all server metrics. One instance per server process."""
@@ -195,6 +206,12 @@ class Metrics:
     # -- convenience --------------------------------------------------------
     def observe_phase(self, model: str, phase: str, ms: float) -> None:
         self.histogram(f"latency_ms{{model={model},phase={phase}}}").observe(ms)
+
+    def set_model_version(self, model: str, version: int) -> None:
+        """model_version{model=}: the live weight-tree version number
+        (tpuserve.lifecycle). A sawtooth on a dashboard = publish followed
+        by rollback."""
+        self.gauge(f"model_version{{model={model}}}").set(float(version))
 
     # -- export -------------------------------------------------------------
     def render_prometheus(self) -> str:

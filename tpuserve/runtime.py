@@ -49,6 +49,11 @@ from tpuserve.parallel.partition import specs_to_shardings
 log = logging.getLogger("tpuserve.runtime")
 
 
+class NaNDetected(ValueError):
+    """A candidate weight tree holds NaN/Inf float leaves; the reload gate
+    (tpuserve.lifecycle) rejects it and the old version keeps serving."""
+
+
 def configure_jax(cfg: ServerConfig) -> None:
     """Process-wide JAX settings (call once, before any compilation)."""
     if cfg.compilation_cache_dir:
@@ -133,6 +138,13 @@ class ModelRuntime:
 
         self.params_per_mesh: list[Any] = []
         self.executables: dict[tuple, list[Executable]] = {}
+        # Versioned lifecycle (tpuserve.lifecycle): the live tree carries a
+        # monotonically numbered version; publish() retains the previous tree
+        # as last-known-good so rollback() is a pointer swap, not a reload.
+        self.version = 1
+        self._version_seq = 1  # never reused, even across rollbacks
+        self._prev_params: list[Any] | None = None
+        self._prev_version: int | None = None
         self._rr = 0  # round-robin cursor for replica mode
         self._rr_lock = threading.Lock()
         self._reload_lock = threading.Lock()
@@ -153,7 +165,8 @@ class ModelRuntime:
         self.params_per_mesh = self._shard_onto_meshes(
             self.model.prepare_host_params(self._load_host_params()))
 
-    def _load_host_params(self) -> Any:
+    def _load_host_params(self, verify_integrity: bool = True,
+                          require_manifest: bool = False) -> Any:
         try:
             cpu = jax.local_devices(backend="cpu")[0]
         except RuntimeError:
@@ -164,6 +177,15 @@ class ModelRuntime:
         else:
             params = self.model.load_params()
         params = jax.device_get(params)
+        # Integrity gate BEFORE the compute-dtype cast: the sidecar manifest
+        # digests the checkpoint's raw bytes, so the comparison must see the
+        # tree exactly as restored.
+        if verify_integrity and self.cfg.weights:
+            from tpuserve import savedmodel
+
+            if savedmodel.detect_format(self.cfg.weights) == "orbax":
+                savedmodel.verify_manifest_if_present(
+                    self.cfg.weights, params, require=require_manifest)
         dtype = jnp.dtype(self.cfg.dtype)
         # Pre-quantized {"q8", "q8_scale"} subtrees stay as saved: scales are
         # deliberately float32 (dequant casts into the compute dtype itself).
@@ -286,8 +308,15 @@ class ModelRuntime:
             self._rr = (self._rr + 1) % len(self.meshes)
             return self._rr
 
-    def run(self, bucket: tuple, host_batch: Any, replica: int | None = None) -> Any:
-        """H2D + async dispatch. Returns device output pytree immediately."""
+    def run(self, bucket: tuple, host_batch: Any, replica: int | None = None,
+            params_override: list[Any] | None = None) -> Any:
+        """H2D + async dispatch. Returns device output pytree immediately.
+
+        ``params_override`` (a per-mesh tree list shaped like
+        ``params_per_mesh``) runs this batch against a DIFFERENT weight tree
+        than the published one — the lifecycle's staged canary executes the
+        candidate version through the real compiled executables without it
+        ever serving traffic."""
         if self.injector is not None:
             delay = self.injector.delay_s("slow_compute", self.model.name)
             if delay > 0:
@@ -296,8 +325,10 @@ class ModelRuntime:
         exes = self.executables[bucket]
         i = replica if replica is not None else self.pick_replica()
         exe = exes[i]
+        params = (params_override if params_override is not None
+                  else self.params_per_mesh)
         dev_batch = jax.tree_util.tree_map(jax.device_put, host_batch, exe.batch_sharding)
-        return exe.compiled(self.params_per_mesh[i], dev_batch)
+        return exe.compiled(params[i], dev_batch)
 
     @staticmethod
     def fetch(outputs: Any) -> Any:
@@ -331,38 +362,115 @@ class ModelRuntime:
         log.info("%s: prewarmed %d executable(s) in %.1fs",
                  self.model.name, len(pending), time.perf_counter() - t0)
 
-    # -- weight reload -------------------------------------------------------
+    # -- versioned weight lifecycle ------------------------------------------
+    #
+    # stage_params -> (staged canary, lifecycle.py) -> publish | rollback.
+    # Staging builds and validates the candidate tree entirely OFF the
+    # serving path; publish is one reference assignment under the reload
+    # lock — no window where inference can observe a half-validated tree,
+    # and in-flight batches finish on the old params (their dispatch
+    # captured the references). The previous tree is retained as
+    # last-known-good so rollback is a pointer swap, not a disk load.
+
+    def stage_params(self, verify_integrity: bool = True,
+                     nan_scan: bool = True,
+                     require_manifest: bool = False) -> list[Any]:
+        """Load + validate a candidate weight tree without publishing it.
+
+        Gates, in order (each names the failure precisely so the lifecycle
+        can label the rejection): sidecar checksum manifest (IntegrityError),
+        NaN/Inf scan of the float leaves (NaNDetected), and shape/dtype/
+        structure match against what the executables were compiled for
+        (ValueError). Injected ``reload_corrupt`` / ``reload_nan`` faults
+        fire at their respective gates so chaos drills prove each rejection
+        path keeps the old version serving."""
+        name = self.model.name
+        if self.injector is not None:
+            from tpuserve.faults import FaultInjected
+            from tpuserve.savedmodel import IntegrityError
+
+            try:
+                self.injector.check("reload_corrupt", name)
+            except FaultInjected as e:
+                raise IntegrityError(
+                    f"checksum mismatch (injected): {e}") from e
+        params = self._load_host_params(verify_integrity=verify_integrity,
+                                        require_manifest=require_manifest)
+        if nan_scan:
+            if self.injector is not None:
+                from tpuserve.faults import FaultInjected
+
+                try:
+                    self.injector.check("reload_nan", name)
+                except FaultInjected as e:
+                    raise NaNDetected(f"NaN leaves (injected): {e}") from e
+            from tpuserve.utils.trees import nonfinite_paths
+
+            bad = nonfinite_paths(params)
+            if bad:
+                raise NaNDetected(
+                    f"candidate weights for {name} hold NaN/Inf in {bad}; "
+                    "candidate rejected")
+        fresh = self._shard_onto_meshes(self.model.prepare_host_params(params))
+        old = self.params_per_mesh
+        if old:
+            same_struct = (jax.tree_util.tree_structure(old[0])
+                           == jax.tree_util.tree_structure(fresh[0]))
+            if not same_struct or any(
+                a.shape != b.shape or a.dtype != b.dtype
+                for a, b in zip(jax.tree_util.tree_leaves(old[0]),
+                                jax.tree_util.tree_leaves(fresh[0]))):
+                raise ValueError(
+                    "reloaded weights do not match the compiled "
+                    "shapes/dtypes; old params kept")
+        return fresh
+
+    def publish(self, staged: list[Any]) -> dict:
+        """Atomically make a staged tree live as version N+1; the previous
+        tree is retained as last-known-good for rollback()."""
+        with self._reload_lock:
+            self._prev_params = self.params_per_mesh
+            self._prev_version = self.version
+            self._version_seq += 1
+            self.version = self._version_seq
+            self.params_per_mesh = staged
+            return {"model": self.model.name, "version": self.version,
+                    "previous_version": self._prev_version}
+
+    def rollback(self) -> dict:
+        """Restore the retained last-known-good tree (version N-1).
+
+        One reference assignment, same publication discipline as publish().
+        Version numbers are never reused: a later publish continues the
+        monotonic sequence. Raises ValueError when nothing is retained
+        (startup state, or already rolled back)."""
+        with self._reload_lock:
+            if self._prev_params is None:
+                raise ValueError(
+                    f"no retained previous version for {self.model.name} "
+                    "to roll back to")
+            rolled_from = self.version
+            self.params_per_mesh = self._prev_params
+            self.version = self._prev_version
+            self._prev_params = None
+            self._prev_version = None
+            return {"model": self.model.name, "version": self.version,
+                    "rolled_back_from": rolled_from}
+
     def reload_params(self) -> dict:
         """Hot-swap weights from cfg.weights without recompiling.
 
-        The executables were compiled against param avals (shape, dtype) and
-        shardings, so any matching reload (updated checkpoint at the same
-        path) slots straight in. The fresh tree is built and validated OFF
-        the serving path and published as one reference assignment — no
-        window where inference can observe a half-validated tree; in-flight
-        batches finish on the old params (their dispatch captured the
-        references). A mismatched tree raises and the old params keep
-        serving. Serialized: concurrent reloads would let a failing call
-        resurrect weights an earlier success replaced.
-        """
-        with self._reload_lock:
-            t0 = time.perf_counter()
-            fresh = self._shard_onto_meshes(self._load_host_params())
-            old = self.params_per_mesh
-            if old:
-                same_struct = (jax.tree_util.tree_structure(old[0])
-                               == jax.tree_util.tree_structure(fresh[0]))
-                if not same_struct or any(
-                    a.shape != b.shape or a.dtype != b.dtype
-                    for a, b in zip(jax.tree_util.tree_leaves(old[0]),
-                                    jax.tree_util.tree_leaves(fresh[0]))):
-                    raise ValueError(
-                        "reloaded weights do not match the compiled "
-                        "shapes/dtypes; old params kept")
-            self.params_per_mesh = fresh
-            return {"model": self.model.name,
-                    "reload_ms": round((time.perf_counter() - t0) * 1e3, 1),
-                    "params": self.describe()["params"]}
+        Compatibility path (stage + publish in one call, no canary): the
+        HTTP reload goes through tpuserve.lifecycle, which canaries the
+        staged tree first and owns rollback. A failed stage raises and the
+        old params keep serving. Serialized via the reload lock in
+        publish(); concurrent stagings are themselves read-only."""
+        t0 = time.perf_counter()
+        staged = self.stage_params()
+        info = self.publish(staged)
+        info["reload_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        info["params"] = self.describe()["params"]
+        return info
 
     # -- info ---------------------------------------------------------------
     def describe(self) -> dict:
@@ -371,6 +479,7 @@ class ModelRuntime:
         return {
             "model": self.model.name,
             "family": self.cfg.family,
+            "version": self.version,
             "mode": self.mode,
             "dtype": self.cfg.dtype,
             "quantize": self.cfg.quantize,

@@ -29,6 +29,8 @@ tests (SURVEY.md §4-4), not here.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
 from typing import Any
@@ -37,6 +39,11 @@ import jax
 import numpy as np
 
 log = logging.getLogger("tpuserve.savedmodel")
+
+
+class IntegrityError(ValueError):
+    """A checkpoint failed its sidecar checksum manifest (tpuserve.lifecycle:
+    the reload path rejects the candidate and the old version keeps serving)."""
 
 
 # -- format detection --------------------------------------------------------
@@ -87,14 +94,92 @@ def load_params_for(model) -> Any:
     return model.import_tf_variables(flat)
 
 
+# -- sidecar checksum manifest (tpuserve.lifecycle integrity gate) -----------
+#
+# Written NEXT TO the orbax dir (<path>.manifest.json), never inside it, so
+# orbax's own directory layout is untouched. Per-leaf sha256 over
+# dtype/shape/raw bytes of the saved host tree; a reload recomputes the
+# digests over the restored tree and any mismatch (bit rot, truncated copy,
+# a writer racing the reload) rejects the candidate before it can serve.
+
+MANIFEST_ALGO = "sha256"
+
+
+def manifest_path(ckpt_path: str) -> str:
+    return os.path.abspath(ckpt_path).rstrip("/") + ".manifest.json"
+
+
+def tree_digests(params: Any) -> dict[str, str]:
+    """{tree path: sha256 hex} over dtype + shape + raw bytes per leaf."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    out: dict[str, str] = {}
+    for path, leaf in flat:
+        a = np.asarray(jax.device_get(leaf))
+        h = hashlib.sha256()
+        h.update(str(a.dtype).encode())
+        h.update(repr(tuple(a.shape)).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+        out[jax.tree_util.keystr(path)] = h.hexdigest()
+    return out
+
+
+def write_manifest(ckpt_path: str, params: Any) -> str:
+    mpath = manifest_path(ckpt_path)
+    doc = {"algo": MANIFEST_ALGO, "leaves": tree_digests(params)}
+    tmp = mpath + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    os.replace(tmp, mpath)  # atomic: a racing reader never sees a torn file
+    return mpath
+
+
+def verify_manifest_if_present(ckpt_path: str, params: Any,
+                               require: bool = False) -> bool:
+    """Check ``params`` against the sidecar manifest; raises IntegrityError on
+    any mismatch. Returns False when no manifest exists (skipped) — unless
+    ``require`` is set, which makes a missing manifest itself a rejection."""
+    mpath = manifest_path(ckpt_path)
+    if not os.path.exists(mpath):
+        if require:
+            raise IntegrityError(
+                f"no checksum manifest at {mpath!r} and lifecycle."
+                "require_manifest is set; re-export the checkpoint with "
+                "save_orbax / import-model")
+        log.debug("no manifest for %s; integrity check skipped", ckpt_path)
+        return False
+    with open(mpath, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("algo") != MANIFEST_ALGO:
+        raise IntegrityError(
+            f"manifest {mpath!r} uses unknown algo {doc.get('algo')!r}")
+    want: dict[str, str] = doc.get("leaves", {})
+    got = tree_digests(params)
+    if got != want:
+        missing = sorted(set(want) - set(got))
+        extra = sorted(set(got) - set(want))
+        changed = sorted(k for k in set(want) & set(got) if want[k] != got[k])
+        detail = "; ".join(
+            f"{label} {paths[:3]}" for label, paths in
+            (("missing", missing), ("unexpected", extra), ("corrupt", changed))
+            if paths)
+        raise IntegrityError(
+            f"checkpoint at {ckpt_path!r} fails its checksum manifest "
+            f"({detail}); candidate rejected")
+    return True
+
+
 # -- orbax native checkpoints ------------------------------------------------
 
 def save_orbax(path: str, params: Any) -> None:
     import orbax.checkpoint as ocp
 
+    host_params = jax.device_get(params)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), jax.device_get(params))
+        ckptr.save(os.path.abspath(path), host_params)
         ckptr.wait_until_finished()
+    # Sidecar integrity manifest: the lifecycle reload gate verifies the
+    # restored tree against these digests before staging.
+    write_manifest(path, host_params)
 
 
 def load_orbax(path: str, model) -> Any:

@@ -20,6 +20,9 @@ All device work happens behind the batcher. Endpoints:
 - ``GET  /debug/trace`` — Chrome trace JSON of recent request spans.
 - ``GET  /v1/models``   — model inventory (buckets, mesh, dtype).
 - ``GET  /``            — minimal HTML upload page for manual poking.
+- ``POST /admin/models/{name}:reload``   — staged, canary-gated weight swap
+  (tpuserve.lifecycle); ``:rollback`` restores the retained previous
+  version; ``GET /admin/models/{name}/versions`` lists the history.
 
 Error mapping: decode failure -> 400, unknown model -> 404, queue full -> 429,
 request deadline exceeded -> 504, batch failure (after retry) -> 500, breaker
@@ -31,6 +34,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures as cf
 import contextlib
+import functools
 import json
 import logging
 import math
@@ -42,9 +46,10 @@ from aiohttp import web
 import jax
 
 from tpuserve import models as modelzoo
-from tpuserve.batcher import ModelBatcher, QueueFull
+from tpuserve.batcher import DeadlineExceeded, ModelBatcher, QueueFull
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
+from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
 from tpuserve.obs import Metrics
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
 
@@ -67,6 +72,9 @@ class ServerState:
         self.runtimes: dict[str, ModelRuntime] = {}
         self.batchers: dict[str, ModelBatcher] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
+        # Versioned reload lifecycle (tpuserve.lifecycle); direct-mode
+        # runtimes only — recycle-mode workers own their params.
+        self.lifecycles: dict[str, ModelLifecycle] = {}
         self.canary_ok: dict[str, bool] = {}
         self._canary_task: asyncio.Task | None = None
         # Chaos layer (docs/ROBUSTNESS.md): None unless [faults] is armed.
@@ -126,6 +134,15 @@ class ServerState:
             self.watchdog.register(name, "group_loop", b.revive_group_loops)
             if hasattr(rt, "watchdog_sweep"):
                 self.watchdog.register(name, "worker", rt.watchdog_sweep)
+            if hasattr(rt, "stage_params"):
+                # functools.partial, not a lambda: late binding would hand
+                # every lifecycle the last loop iteration's name.
+                self.lifecycles[name] = ModelLifecycle(
+                    name, rt, model, self.cfg.lifecycle, self.metrics,
+                    breaker=br,
+                    canary=functools.partial(self.run_canary, name),
+                    canary_status=functools.partial(self.canary_ok.get, name),
+                    injector=self.injector)
         if self.cfg.startup_canary:
             await self.run_canaries()
         if self.cfg.canary_interval_s > 0:
@@ -232,6 +249,8 @@ class ServerState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
+        for lc in self.lifecycles.values():
+            lc.close()  # stop soak monitors
         if self._canary_task is not None:
             self._canary_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
@@ -278,6 +297,19 @@ async def handle_predict(request: web.Request) -> web.Response:
     body = await request.read()
     ctype = request.content_type or ""
 
+    # Per-request deadline (docs/ROBUSTNESS.md): the client's timeout_ms
+    # (JSON body key, ?timeout_ms= query, or X-Timeout-Ms header) overrides
+    # the model's request_timeout_ms. The absolute deadline is stamped at
+    # admission and travels with each queued item, so the batcher can fail
+    # already-dead work in microseconds instead of dispatching it.
+    try:
+        timeout_ms = _requested_timeout_ms(request, body, ctype)
+    except ValueError as e:
+        return _err(400, str(e))
+    timeout_s = (timeout_ms if timeout_ms is not None
+                 else mcfg.request_timeout_ms) / 1e3
+    deadline_at = t_start + timeout_s
+
     try:
         if state.injector is not None:
             state.injector.check("decode_corrupt", name)
@@ -299,7 +331,7 @@ async def handle_predict(request: web.Request) -> web.Response:
     try:
         for item in items:
             futs.append(state.batchers[name].submit(
-                item, group=model.group_key(item)))
+                item, group=model.group_key(item), deadline_at=deadline_at))
     except QueueFull:
         for f in futs:
             f.cancel()
@@ -313,13 +345,24 @@ async def handle_predict(request: web.Request) -> web.Response:
         return _err(503, f"server not accepting requests: {e}")
 
     try:
-        timeout = mcfg.request_timeout_ms / 1e3
-        results = await asyncio.wait_for(asyncio.gather(*futs), timeout=timeout)
+        remaining = max(0.0, deadline_at - time.perf_counter())
+        # With an explicit client deadline the batcher enforces it precisely
+        # at flush time (fast 504 + deadline_exceeded_total); the HTTP timer
+        # then runs slightly late as a pure backstop so the two never race.
+        grace = 0.25 if timeout_ms is not None else 0.0
+        results = await asyncio.wait_for(asyncio.gather(*futs),
+                                         timeout=remaining + grace)
     except asyncio.TimeoutError:
         for f in futs:
             f.cancel()
         metrics.counter(f"timeouts_total{{model={name}}}").inc()
-        return _err(504, f"request deadline ({mcfg.request_timeout_ms} ms) exceeded")
+        return _err(504, f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
+    except DeadlineExceeded as e:
+        # The batcher rejected the queued work before dispatch: same 504 as
+        # the timer path, but fast, and counted in deadline_exceeded_total.
+        for f in futs:
+            f.cancel()
+        return _err(504, f"deadline_exceeded: {e}")
     except Exception as e:
         for f in futs:
             f.cancel()
@@ -371,6 +414,11 @@ async def handle_stats(request: web.Request) -> web.Response:
     }
     if state.injector is not None:
         out["robustness"]["faults"] = state.injector.snapshot()
+    # Versioned lifecycle state: what version is live per model, what is
+    # retained for rollback, and the recent transition history.
+    if state.lifecycles:
+        out["lifecycle"] = {n: lc.describe()
+                            for n, lc in state.lifecycles.items()}
     return web.json_response(out)
 
 
@@ -399,29 +447,65 @@ See <a href="/v1/models">models</a>, <a href="/metrics">metrics</a>,
 
 
 async def handle_reload(request: web.Request) -> web.Response:
-    """POST /admin/models/{name}:reload — hot-swap weights from disk.
+    """POST /admin/models/{name}:reload — staged, reversible weight swap.
 
-    Same shapes slot into the compiled executables with zero recompilation;
-    a mismatched checkpoint 409s and the old weights keep serving. The
-    canary reruns so /healthz reflects the new weights."""
+    Lifecycle-backed (tpuserve.lifecycle): the candidate is integrity-checked
+    and canaried against its STAGED params before publishing as a numbered
+    version; same shapes slot into the compiled executables with zero
+    recompilation. Any gate failure 409s with the failing ``stage`` and the
+    old version keeps serving — including a post-publish canary failure,
+    which auto-rolls back (500 + ``rolled_back: true``) instead of leaving
+    bad weights live."""
     state: ServerState = request.app[STATE_KEY]
     name = request.match_info["name"]
-    rt = state.runtimes.get(name)
-    if rt is None:
+    if name not in state.runtimes:
         return _err(404, f"unknown model {name!r}")
-    if not hasattr(rt, "reload_params"):
+    lc = state.lifecycles.get(name)
+    if lc is None:
         return _err(409, "weight reload is not supported in recycle mode")
-    loop = asyncio.get_running_loop()
     try:
-        # Default executor, NOT state.pool: a slow checkpoint load must not
-        # occupy a decode/fetch thread the batcher depends on.
-        info = await loop.run_in_executor(None, rt.reload_params)
-    except ValueError as e:
-        return _err(409, str(e))
+        info = await lc.reload()
+    except ReloadRejected as e:
+        body = {"error": str(e), "stage": e.stage,
+                "rolled_back": e.rolled_back,
+                "version": state.runtimes[name].version}
+        # Pre-publish rejection = client/artifact conflict (409); a
+        # post-publish rollback means the server briefly published bad
+        # weights and recovered (500 so operators page on it).
+        return web.json_response(body, status=500 if e.rolled_back else 409)
     except Exception as e:  # noqa: BLE001
         return _err(500, f"reload failed: {e}")
-    info["canary_ok"] = await state.run_canary(name)
     return web.json_response(info)
+
+
+async def handle_rollback(request: web.Request) -> web.Response:
+    """POST /admin/models/{name}:rollback — restore version N-1 (the
+    retained last-known-good tree). 409 when nothing is retained."""
+    state: ServerState = request.app[STATE_KEY]
+    name = request.match_info["name"]
+    if name not in state.runtimes:
+        return _err(404, f"unknown model {name!r}")
+    lc = state.lifecycles.get(name)
+    if lc is None:
+        return _err(409, "versioned lifecycle is not supported in recycle mode")
+    try:
+        info = await lc.rollback(reason="manual")
+    except ValueError as e:
+        return _err(409, str(e))
+    return web.json_response(info)
+
+
+async def handle_versions(request: web.Request) -> web.Response:
+    """GET /admin/models/{name}/versions — live version, retained previous
+    version, soak state, and the transition history."""
+    state: ServerState = request.app[STATE_KEY]
+    name = request.match_info["name"]
+    if name not in state.runtimes:
+        return _err(404, f"unknown model {name!r}")
+    lc = state.lifecycles.get(name)
+    if lc is None:
+        return _err(409, "versioned lifecycle is not supported in recycle mode")
+    return web.json_response(lc.describe())
 
 
 async def handle_index(request: web.Request) -> web.Response:
@@ -435,6 +519,33 @@ def _err(status: int, message: str,
                              headers=headers)
 
 
+def _requested_timeout_ms(request: web.Request, body: bytes,
+                          ctype: str) -> float | None:
+    """Client-supplied per-request deadline: ``timeout_ms`` as a top-level
+    JSON body key, a ``?timeout_ms=`` query parameter, or an
+    ``X-Timeout-Ms`` header (binary bodies can't carry a JSON key). None
+    when absent; ValueError (-> 400) when present but not a positive
+    number. The substring guard keeps the extra JSON parse off every
+    text/prompt request that doesn't use the feature."""
+    raw = request.query.get("timeout_ms") or request.headers.get("X-Timeout-Ms")
+    if raw is None and ctype == "application/json" and b"timeout_ms" in body:
+        try:
+            parsed = json.loads(body)
+        except ValueError:
+            return None  # model decode owns malformed-body errors
+        if isinstance(parsed, dict):
+            raw = parsed.get("timeout_ms")
+    if raw is None:
+        return None
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        raise ValueError(f"timeout_ms must be a number, got {raw!r}") from None
+    if not math.isfinite(val) or val <= 0:
+        raise ValueError(f"timeout_ms must be a positive number, got {val}")
+    return val
+
+
 # -- app wiring --------------------------------------------------------------
 
 def make_app(state: ServerState) -> web.Application:
@@ -444,6 +555,8 @@ def make_app(state: ServerState) -> web.Application:
         app.router.add_post(f"/v1/models/{{name}}:{verb}", handle_predict)
     app.router.add_get("/v1/models", handle_models)
     app.router.add_post("/admin/models/{name}:reload", handle_reload)
+    app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
+    app.router.add_get("/admin/models/{name}/versions", handle_versions)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/stats", handle_stats)

@@ -24,3 +24,29 @@ def tree_summary(tree: Any) -> dict:
         "bytes": tree_size_bytes(tree),
         "params": sum(int(np.prod(x.shape)) for x in leaves if hasattr(x, "shape")),
     }
+
+
+def nonfinite_paths(tree: Any, limit: int = 8) -> list[str]:
+    """Tree paths of float leaves holding any NaN/Inf (first ``limit``).
+
+    The lifecycle reload gate scans candidate weight trees with this: a
+    poisoned checkpoint (NaN from a diverged fine-tune, Inf from a bf16
+    overflow) must be rejected before it can serve. Runs on host arrays;
+    numpy classifies bfloat16 as non-float (kind 'V'), so those leaves are
+    widened to float32 for the scan."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    bad: list[str] = []
+    for path, leaf in flat:
+        a = np.asarray(leaf)
+        if a.dtype.kind not in "fV":
+            continue
+        if a.dtype.kind == "V":  # ml_dtypes bfloat16 et al.
+            try:
+                a = a.astype(np.float32)
+            except (TypeError, ValueError):
+                continue  # genuinely structured dtype: nothing to scan
+        if not np.isfinite(a).all():
+            bad.append(jax.tree_util.keystr(path))
+            if len(bad) >= limit:
+                break
+    return bad
