@@ -19,4 +19,7 @@ python -m pytest tests/test_lifecycle.py -q -p no:cacheprovider
 echo "== reload drill (reload_corrupt @ 100%, availability >= 99%) =="
 scripts/reload_drill.sh
 
+echo "== pipeline smoke (closed loop, zero errors, live occupancy) =="
+scripts/pipeline_smoke.sh
+
 echo "chaos smoke OK"

@@ -56,6 +56,36 @@ def test_options_dict_override(tmp_path):
     assert cfg.model("sd").options["num_steps"] == 4
 
 
+def test_pipeline_block(tmp_path):
+    p = tmp_path / "pipe.toml"
+    p.write_text(
+        """
+[pipeline]
+h2d_workers = 4
+depth = 3
+arena_slots = 8
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.pipeline.h2d_workers == 4
+    assert cfg.pipeline.depth == 3
+    assert cfg.pipeline.arena_slots == 8
+    assert cfg.pipeline.assemble_workers == 2  # default preserved
+
+
+def test_pipeline_block_validation():
+    from tpuserve.config import PipelineConfig
+
+    with pytest.raises(ValueError, match="fetch_workers"):
+        PipelineConfig(fetch_workers=0)
+    with pytest.raises(ValueError, match=">= 0"):
+        PipelineConfig(depth=-1)
+
+
 def test_unknown_key_rejected(tmp_path):
     p = tmp_path / "bad.toml"
     p.write_text("bogus_key = 1\n")

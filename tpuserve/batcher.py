@@ -12,9 +12,18 @@ shapes, so this batcher assembles **padded, bucketed** batches:
   zero-pads up to it; ``host_postprocess`` only reads the valid rows, and
   padded lanes are tested to never perturb real lanes
   (tests/test_runtime.py::test_padding_lanes_do_not_affect_real_lanes).
-- Dispatch is pipelined: up to ``max_inflight`` batches are in flight on the
-  device at once (assembly, H2D and the blocking D2H fetch run in a
-  threadpool; the event loop never blocks), hiding H2D under compute.
+
+Dispatch is a **staged pipeline** (ISSUE 3; docs/PERFORMANCE.md): instead of
+one shared threadpool running assemble -> device_put -> blocking fetch
+sequentially per batch, each stage has its own executor
+(tpuserve.hostpipe.StageExecutors) so consecutive batches occupy different
+stages concurrently — batch N+1 assembles and transfers while batch N
+computes. Assembly writes into preallocated per-bucket arena buffers
+(AssemblyArena) recycled through a free-list instead of np.stack-allocating
+per batch, and a depth-k staging-slot pool per replica (SlotPool) bounds how
+many batches occupy the device section [h2d..fetch] at once. Admission into
+the pipeline (depth x replicas + assemble_ahead batches) replaces the old
+single semaphore acquired before assembly even started.
 
 Failure containment (SURVEY.md §5, docs/ROBUSTNESS.md): a failed dispatch
 first re-assembles and re-runs the batch once (``batch_retry``); if the
@@ -26,7 +35,8 @@ the dispatch call sites, and dead group tasks are revived by the server
 watchdog (``revive_group_loops``). Client disconnects cancel futures, which
 are dropped at flush time. Requests carrying a per-request deadline
 (``timeout_ms``) that expires while queued fail fast with DeadlineExceeded
-at flush time — rejected in microseconds, not computed for nobody (P3).
+at flush time or while waiting for admission/staging capacity — rejected in
+microseconds, not computed for nobody (P3).
 """
 
 from __future__ import annotations
@@ -38,6 +48,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Hashable
 
+from tpuserve.config import PipelineConfig
+from tpuserve.hostpipe import AssemblyArena, SlotPool, StageExecutors
 from tpuserve.models.base import ServingModel
 from tpuserve.obs import Metrics
 from tpuserve.runtime import ModelRuntime
@@ -77,6 +89,8 @@ class ModelBatcher:
         pool: cf.ThreadPoolExecutor,
         breaker: "Any | None" = None,
         injector: "Any | None" = None,
+        stages: "StageExecutors | None" = None,
+        pipeline_cfg: "PipelineConfig | None" = None,
     ) -> None:
         self.model = model
         self.runtime = runtime
@@ -84,14 +98,42 @@ class ModelBatcher:
         # an in-process runtime: dispatch awaits epoch readback.
         self.deferred = hasattr(runtime, "run_deferred")
         self.metrics = metrics
+        # Legacy shared pool (the server's decode pool). The hot path no
+        # longer runs on it — stage executors own assemble/h2d/fetch/postproc
+        # — but the argument stays for API stability with callers/tests.
         self.pool = pool
         self.cfg = model.cfg
+        self.pipeline_cfg = pipeline_cfg or PipelineConfig()
+        # Stage executors are normally server-owned and shared across models
+        # (stage-granularity scheduling); a batcher built without one (tests,
+        # embedding) creates and later shuts down its own.
+        self._own_stages = stages is None
+        self.stages = stages if stages is not None \
+            else StageExecutors(self.pipeline_cfg, metrics)
         self._queues: dict[Hashable, asyncio.Queue[_Request]] = {}
         self._tasks: dict[Hashable, asyncio.Task] = {}
         self._dispatch_tasks: set[asyncio.Task] = set()
         self._inflight: asyncio.Semaphore | None = None
+        self._staging: list[SlotPool] = []
+        self.arena: AssemblyArena | None = None
+        self.depth = 0
+        self._admission_cap = 0
+        self._inflight_now = 0
+        self._inflight_peak = 0
+        self._idle_event: asyncio.Event | None = None
         self._pending = 0
         self._running = False
+        # Arena assembly requires assemble_into to produce exactly what
+        # assemble would: provable only when assemble is the base
+        # implementation, or the family overrode assemble_into alongside its
+        # custom assemble. Wrappers that monkey with assemble (tests) fall
+        # back to the allocating path automatically.
+        t = type(model)
+        a = getattr(t, "assemble", None)
+        ai = getattr(t, "assemble_into", None)
+        self._use_arena = (a is ServingModel.assemble
+                           or (ai is not None
+                               and ai is not ServingModel.assemble_into))
         # Per-model circuit breaker (tpuserve.faults.CircuitBreaker): fed
         # dispatch outcomes here, consulted by the HTTP layer.
         self.breaker = breaker
@@ -101,18 +143,43 @@ class ModelBatcher:
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
         self._running = True
-        self._inflight = asyncio.Semaphore(max(1, self.cfg.max_inflight))
+        pcfg = self.pipeline_cfg
+        if self.deferred:
+            # Deferred mode: enqueue's shm-slot wait is the device
+            # backpressure; the semaphore bounds batches between assembly
+            # and enqueue exactly as before.
+            self._admission_cap = max(1, self.cfg.max_inflight)
+            self._staging = []
+            self.arena = None
+            self.depth = 0
+        else:
+            n_rep = max(1, int(getattr(self.runtime, "n_replicas", 1)))
+            self.depth = max(1, pcfg.depth or self.cfg.max_inflight)
+            self._staging = [SlotPool(self.depth) for _ in range(n_rep)]
+            self._admission_cap = self.depth * n_rep + pcfg.assemble_ahead
+            arena_slots = pcfg.arena_slots or (self.depth + pcfg.assemble_ahead)
+            self.arena = (AssemblyArena(self.model, arena_slots, self.metrics)
+                          if self._use_arena else None)
+        self._inflight = asyncio.Semaphore(self._admission_cap)
+        self._idle_event = asyncio.Event()
+        self._idle_event.set()
 
     async def stop(self) -> None:
         """Cancel accumulation, fail queued requests, drain in-flight batches."""
         self._running = False
         for t in self._tasks.values():
             t.cancel()
-        for t in self._tasks.values():
+        for group, t in self._tasks.items():
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
-                pass  # a loop that already died must not abort stop()
+            except asyncio.CancelledError:
+                pass  # the cancellation we just requested — expected
+            except Exception:
+                # A loop that already died must not abort stop(), but its
+                # death is a real failure, not shutdown noise — surface it
+                # instead of swallowing it with the cancellation.
+                log.exception("group loop %r for %s failed during stop",
+                              group, self.model.name)
         self._tasks.clear()
         # Requests still queued (never dispatched) must not hang their
         # clients: fail them explicitly (ADVICE r1: stop() cleared queues
@@ -127,6 +194,9 @@ class ModelBatcher:
         self._queues.clear()
         if self._dispatch_tasks:
             await asyncio.gather(*self._dispatch_tasks, return_exceptions=True)
+        self._maybe_idle()
+        if self._own_stages:
+            self.stages.shutdown()
 
     # -- submission (event loop) --------------------------------------------
     def submit(self, item: Any, group: Hashable = None,
@@ -151,6 +221,7 @@ class ModelBatcher:
             self._tasks[group] = loop.create_task(self._group_loop(group, q))
         q.put_nowait(req)
         self._pending += 1
+        self._idle_event.clear()
         self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
         return fut
 
@@ -179,14 +250,37 @@ class ModelBatcher:
             revived += 1
         return revived
 
+    def _maybe_idle(self) -> None:
+        """Signal drain() waiters when no accepted work remains. Spurious
+        sets are fine — drain re-checks under its clear/recheck discipline."""
+        if self._idle_event is not None and self._pending == 0 \
+                and not self._dispatch_tasks:
+            self._idle_event.set()
+
     async def drain(self, deadline: float) -> bool:
         """Graceful drain: wait until every accepted request (queued or in
         flight) has resolved, bounded by ``deadline`` (event-loop time).
-        The caller stops admitting new work first (server.draining)."""
+        The caller stops admitting new work first (server.draining).
+
+        Wakes on the idle event set by the last completion instead of
+        polling on an interval (the old 20 ms sleep loop added avoidable
+        shutdown latency and jitter at high batch rates)."""
         loop = asyncio.get_running_loop()
-        while (self._pending > 0 or self._dispatch_tasks) \
-                and loop.time() < deadline:
-            await asyncio.sleep(0.02)
+        while self._pending > 0 or self._dispatch_tasks:
+            timeout = deadline - loop.time()
+            if timeout <= 0:
+                break
+            # clear-then-recheck: the loop is single-threaded, so a
+            # completion between the recheck and wait() is impossible and
+            # no wakeup can be missed.
+            self._idle_event.clear()
+            if self._pending == 0 and not self._dispatch_tasks:
+                break
+            try:
+                await asyncio.wait_for(self._idle_event.wait(), timeout)
+            except asyncio.TimeoutError:
+                break
+        self._maybe_idle()  # leave the event consistent for the next drain
         return self._pending == 0 and not self._dispatch_tasks
 
     def _expire_dead(self, reqs: list[_Request],
@@ -195,7 +289,7 @@ class ModelBatcher:
         ``deadline_exceeded_total``) and drop already-done futures; returns
         the still-live rest. ``adjust_pending`` settles the queue-depth
         accounting for dropped requests when the batch-wide decrement has
-        not run yet (the slot-wait call sites)."""
+        not run yet (the admission-wait call sites)."""
         now = time.perf_counter()
         live: list[_Request] = []
         n_expired = 0
@@ -220,6 +314,7 @@ class ModelBatcher:
         if adjust_pending and len(live) != len(reqs):
             self.metrics.gauge(
                 f"queue_depth{{model={self.model.name}}}").set(self._pending)
+            self._maybe_idle()
         return live
 
     # -- accumulation (event loop) ------------------------------------------
@@ -243,12 +338,13 @@ class ModelBatcher:
                         batch.append(await asyncio.wait_for(q.get(), timeout))
                     except asyncio.TimeoutError:
                         break
-                # Backpressure: the semaphore bounds in-flight device batches;
-                # the group task itself waits here, which pipelines dispatch.
-                # The wait is bounded by the earliest per-request deadline in
-                # the batch (P3): a request that dies behind slow in-flight
-                # work fails fast AT its deadline, instead of being
-                # discovered dead only when a slot finally frees.
+                # Backpressure: admission bounds batches inside the pipeline
+                # (depth x replicas in the device section + assemble_ahead
+                # ramping through assembly); the group task itself waits
+                # here. The wait is bounded by the earliest per-request
+                # deadline in the batch (P3): a request that dies behind
+                # slow in-flight work fails fast AT its deadline, instead of
+                # being discovered dead only when capacity finally frees.
                 batch = self._expire_dead(batch, adjust_pending=True)
                 while batch:
                     earliest = min((r.deadline_at for r in batch
@@ -267,7 +363,7 @@ class ModelBatcher:
                             pass
                     batch = self._expire_dead(batch, adjust_pending=True)
                 if not batch:
-                    continue  # everything expired; no slot was acquired
+                    continue  # everything expired; no admission was taken
             except asyncio.CancelledError:
                 # stop() cancelled us mid-accumulation: requests already
                 # pulled off the queue must fail, not hang their clients.
@@ -277,9 +373,10 @@ class ModelBatcher:
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(err)
+                self._maybe_idle()
                 raise
             # Adaptive drain: anything that queued while we waited (deadline or
-            # a free slot) would only wait longer — fold it into this batch up
+            # admission) would only wait longer — fold it into this batch up
             # to the largest bucket. This makes batch size track device speed
             # instead of deadline x arrival-rate (SURVEY.md §7 hard-part 2).
             while len(batch) < max_bucket and not q.empty():
@@ -293,6 +390,7 @@ class ModelBatcher:
             live = self._expire_dead(live, adjust_pending=False)
             if not live:
                 self._inflight.release()
+                self._maybe_idle()
                 continue
             now = time.perf_counter()
             for r in live:
@@ -300,14 +398,19 @@ class ModelBatcher:
             task = asyncio.get_running_loop().create_task(self._dispatch(live, group))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._dispatch_tasks.discard)
+            task.add_done_callback(lambda _t: self._maybe_idle())
 
-    # -- dispatch (threadpool does the blocking work) ------------------------
+    # -- dispatch (stage executors do the blocking work) ---------------------
     async def _dispatch(self, reqs: list[_Request], group: Hashable) -> None:
-        """Run one batch; on failure, retry/split per config before failing
-        futures. Failure is contained to this batch either way: the group
-        task and server keep serving."""
+        """Run one batch through the pipeline; on failure, retry/split per
+        config before failing futures. Failure is contained to this batch
+        either way: the group task and server keep serving."""
         name = self.model.name
-        released = [False]  # deferred mode releases the semaphore mid-flight
+        released = [False]  # deferred mode releases admission mid-flight
+        self._inflight_now += 1
+        self._inflight_peak = max(self._inflight_peak, self._inflight_now)
+        occupancy = self.metrics.gauge(f"pipeline_inflight{{model={name}}}")
+        occupancy.set(self._inflight_now)
         try:
             try:
                 await self._execute(reqs, group, released)
@@ -331,15 +434,44 @@ class ModelBatcher:
                     for r in live:
                         r.future.set_exception(e)
         finally:
+            self._inflight_now -= 1
+            occupancy.set(self._inflight_now)
             if not released[0]:
                 self._inflight.release()
 
+    async def _acquire_staging(self, reqs: list[_Request]) -> tuple[int | None, int | None]:
+        """Pick a replica and take one of its depth-k staging slots, bounded
+        by the earliest per-request deadline. Tries every replica's pool
+        before waiting (a free slot anywhere beats queueing on the
+        round-robin pick). Returns (replica, slot), or (None, None) when
+        every request expired while waiting — their futures already carry
+        DeadlineExceeded (fast 504)."""
+        live = [r for r in reqs if not r.future.done()]
+        n = len(self._staging)
+        while True:
+            first = self.runtime.pick_replica() if n > 1 else 0
+            for k in range(n):
+                i = (first + k) % n
+                slot = self._staging[i].try_acquire()
+                if slot is not None:
+                    return i, slot
+            live = self._expire_dead(live, adjust_pending=False)
+            if not live:
+                return None, None
+            earliest = min((r.deadline_at for r in live
+                            if r.deadline_at is not None), default=None)
+            timeout = (None if earliest is None
+                       else max(0.0, earliest - time.perf_counter()))
+            try:
+                return first, await self._staging[first].acquire(timeout)
+            except asyncio.TimeoutError:
+                continue
+
     async def _execute(self, reqs: list[_Request], group: Hashable,
                        released: list[bool]) -> None:
-        """Assemble + run + postprocess one batch, resolving futures on
-        success. Raises on failure WITHOUT failing futures — the caller
-        owns the retry policy."""
-        loop = asyncio.get_running_loop()
+        """Assemble + run + postprocess one batch through the stage
+        pipeline, resolving futures on success. Raises on failure WITHOUT
+        failing futures — the caller owns the retry policy."""
         name = self.model.name
         bucket = self.model.bucket_for(len(reqs), group=group)
         fill = len(reqs) / bucket[0]
@@ -349,48 +481,81 @@ class ModelBatcher:
         wall0 = time.time()
         t0 = time.perf_counter()
         items = [r.item for r in reqs]
-        host_batch = await loop.run_in_executor(
-            self.pool, self.model.assemble, items, bucket
-        )
-        t1 = time.perf_counter()
-        self.metrics.observe_phase(name, "preproc", (t1 - t0) * 1e3)
+        # Assemble stage: into a recycled arena buffer when provably
+        # equivalent, else the model's allocating assemble.
+        lease = self.arena.acquire(bucket) if self.arena is not None else None
+        try:
+            if lease is not None:
+                host_batch = await self.stages.run(
+                    name, "assemble", self.model.assemble_into,
+                    items, bucket, lease.buf)
+            else:
+                host_batch = await self.stages.run(
+                    name, "assemble", self.model.assemble, items, bucket)
+            t1 = time.perf_counter()
+            self.metrics.observe_phase(name, "preproc", (t1 - t0) * 1e3)
 
-        if self.injector is not None:
-            delay = self.injector.delay_s("slow_dispatch", name)
-            if delay > 0:
-                await asyncio.sleep(delay)
-            self.injector.check("batch_error", name)
+            if self.deferred:
+                # Deferred mode: enqueue is cheap (shm write + slot wait =
+                # the backpressure), so admission is released as soon as the
+                # batch is on its worker; the await then spans the rest of
+                # the owning worker's epoch + bulk readback, which is what
+                # "compute" measures in this mode by design.
+                if self.injector is not None:
+                    delay = self.injector.delay_s("slow_dispatch", name)
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    self.injector.check("batch_error", name)
+                out_fut = await self.runtime.enqueue(bucket, host_batch)
+                t2 = time.perf_counter()
+                self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+                if not released[0]:
+                    self._inflight.release()
+                    released[0] = True
+                np_out = await out_fut
+                t3 = time.perf_counter()
+                self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+            else:
+                # Device section: a staging slot bounds batches inside
+                # [h2d..fetch] to depth-k per replica; the wait is
+                # deadline-bounded (fast 504 for work nobody awaits).
+                replica, slot = await self._acquire_staging(reqs)
+                if replica is None:
+                    return  # every request expired; nothing to run
+                try:
+                    if self.injector is not None:
+                        delay = self.injector.delay_s("slow_dispatch", name)
+                        if delay > 0:
+                            await asyncio.sleep(delay)
+                        self.injector.check("batch_error", name)
+                    # h2d stage: batched device_put of the whole pytree +
+                    # async dispatch of the compiled call.
+                    outputs = await self.stages.run(
+                        name, "h2d", self.runtime.run, bucket, host_batch,
+                        replica)
+                    t2 = time.perf_counter()
+                    self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
 
-        if self.deferred:
-            # Deferred mode: enqueue is cheap (shm write + slot wait = the
-            # backpressure), so the inflight semaphore is released as soon
-            # as the batch is on its worker; the await then spans the rest
-            # of the owning worker's epoch + bulk readback, which is what
-            # "compute" measures in this mode by design.
-            out_fut = await self.runtime.enqueue(bucket, host_batch)
-            t2 = time.perf_counter()
-            self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
-            if not released[0]:
-                self._inflight.release()
-                released[0] = True
-            np_out = await out_fut
-            t3 = time.perf_counter()
-            self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
-        else:
-            outputs = await loop.run_in_executor(self.pool, self.runtime.run, bucket, host_batch)
-            t2 = time.perf_counter()
-            self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+                    # fetch stage: "compute" = dispatch-to-ready wall time.
+                    # With per-stage executors this is the device's own
+                    # queue + MXU time; it no longer absorbs other batches'
+                    # transfer waits the way the shared-pool path did
+                    # (docs/PERFORMANCE.md "Phase semantics").
+                    np_out = await self.stages.run(
+                        name, "fetch", self.runtime.fetch, outputs)
+                    t3 = time.perf_counter()
+                    self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+                finally:
+                    self._staging[replica].release(slot)
+        finally:
+            if lease is not None:
+                # Safe only now: the fetch completing proves the device is
+                # done reading the batch (CPU-backend device_put may alias
+                # this buffer).
+                self.arena.release(lease)
 
-            # "compute" = dispatch-to-ready wall time. With pipelined
-            # dispatch that includes waiting behind the other in-flight
-            # batches' transfers, so on a transfer-bound link this phase
-            # absorbs the wire wait (BASELINE.md "Link physics"), not
-            # just MXU time.
-            np_out = await loop.run_in_executor(self.pool, self.runtime.fetch, outputs)
-            t3 = time.perf_counter()
-            self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
-
-        results = self.model.host_postprocess(np_out, len(reqs))
+        results = await self.stages.run(
+            name, "postproc", self.model.host_postprocess, np_out, len(reqs))
         t4 = time.perf_counter()
         self.metrics.observe_phase(name, "postproc", (t4 - t3) * 1e3)
         self.metrics.counter(f"items_total{{model={name}}}").inc(len(reqs))
@@ -442,3 +607,21 @@ class ModelBatcher:
                     await run_split(live[mid:])
 
         await run_split(reqs)
+
+    # -- introspection -------------------------------------------------------
+    def pipeline_stats(self) -> dict:
+        """The /stats "pipeline" block entry for this model
+        (docs/PERFORMANCE.md "Reading the metrics")."""
+        out = {
+            "mode": "deferred" if self.deferred else "direct",
+            "admission": self._admission_cap,
+            "inflight": self._inflight_now,
+            "inflight_peak": self._inflight_peak,
+        }
+        if not self.deferred:
+            out["depth"] = self.depth
+            out["replicas"] = len(self._staging)
+            out["staging_in_use"] = [p.in_use for p in self._staging]
+            out["arena"] = (self.arena.stats()
+                            if self.arena is not None else None)
+        return out

@@ -115,6 +115,44 @@ class LifecycleConfig:
 
 
 @dataclass
+class PipelineConfig:
+    """Pipelined host execution engine (``[pipeline]`` TOML; tpuserve.hostpipe,
+    docs/PERFORMANCE.md).
+
+    The direct-mode hot path runs as a staged pipeline — decode/assemble,
+    H2D transfer + dispatch, D2H fetch, postprocess — with a dedicated thread
+    pool per stage so consecutive batches occupy different stages
+    concurrently, preallocated per-bucket assembly arenas instead of
+    per-batch np.stack allocation, and a depth-k staging-slot pool per
+    replica bounding batches in the device section ([h2d..fetch])."""
+
+    # Thread-pool size per stage (shared across every direct-mode model).
+    assemble_workers: int = 2
+    h2d_workers: int = 2
+    fetch_workers: int = 2
+    postproc_workers: int = 2
+    # Batches in flight per replica inside [h2d..fetch] ("staging slots");
+    # 0 derives it from each model's max_inflight.
+    depth: int = 0
+    # Extra batches admitted past the device depth so assembly runs ahead of
+    # the device (the pipeline's ramp): admission = depth*replicas + this.
+    assemble_ahead: int = 2
+    # Preallocated assembly buffers per (model, bucket); 0 sizes it to
+    # depth + assemble_ahead. Acquires beyond this fall back to one-shot
+    # allocations counted in arena_overflow_total{model=}.
+    arena_slots: int = 0
+
+    def __post_init__(self) -> None:
+        for f in ("assemble_workers", "h2d_workers", "fetch_workers",
+                  "postproc_workers"):
+            if getattr(self, f) < 1:
+                raise ValueError(f"pipeline.{f} must be >= 1")
+        if self.depth < 0 or self.assemble_ahead < 0 or self.arena_slots < 0:
+            raise ValueError(
+                "pipeline.depth/assemble_ahead/arena_slots must be >= 0")
+
+
+@dataclass
 class ModelConfig:
     """Per-model serving configuration."""
 
@@ -185,7 +223,10 @@ class ModelConfig:
     options: dict[str, Any] = field(default_factory=dict)
     # Number of classes / detection size etc. where the family needs it.
     num_classes: int = 1000
-    # Number of in-flight device batches the dispatcher pipelines (>=1).
+    # Device-section pipeline depth per replica (>=1): how many of this
+    # model's batches occupy [h2d..fetch] staging slots at once. The
+    # server-wide [pipeline] block's `depth` overrides it when nonzero; in
+    # recycle mode it bounds batches between assembly and shm enqueue.
     max_inflight: int = 2
     # Execution mode (SURVEY.md C5; tpuserve/deferred.py):
     # - "direct":  per-batch dispatch + readback in-process (real TPU / CPU).
@@ -285,6 +326,8 @@ class ServerConfig:
     # Emit one JSON object per log line (machine-ingestible) instead of the
     # human-readable default.
     log_json: bool = False
+    # Pipelined host execution engine knobs (stage pools, depth, arenas).
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     # Deterministic fault injection (chaos testing); disabled by default.
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     # Versioned reload lifecycle (integrity checks, staged canary, rollback).
@@ -330,12 +373,15 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     dist_dict = raw.pop("distributed", None)
     faults_dict = raw.pop("faults", None)
     lifecycle_dict = raw.pop("lifecycle", None)
+    pipeline_dict = raw.pop("pipeline", None)
     cfg: ServerConfig = _build(ServerConfig, raw)
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
     if dist_dict is not None:
         cfg.distributed = _build(DistributedConfig, dist_dict)
     if lifecycle_dict is not None:
         cfg.lifecycle = _build(LifecycleConfig, lifecycle_dict)
+    if pipeline_dict is not None:
+        cfg.pipeline = _build(PipelineConfig, pipeline_dict)
     if faults_dict is not None:
         rule_dicts = faults_dict.pop("rule", [])
         cfg.faults = _build(FaultsConfig, faults_dict)

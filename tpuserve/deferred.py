@@ -69,6 +69,7 @@ from typing import Any
 import numpy as np
 
 from tpuserve.config import ModelConfig
+from tpuserve.hostpipe import SlotPool, SlotsClosed
 
 log = logging.getLogger("tpuserve.deferred")
 
@@ -315,7 +316,11 @@ class _Worker:
         self.rows_used = 0
         self.first_batch_t: float | None = None
         self.pending: list[_PendingBatch] = []
-        self.free_slots: list[int] = list(range(n_slots))
+        # Shared staging-slot abstraction (tpuserve.hostpipe.SlotPool): the
+        # same bounded async slot pool the batcher's pipeline uses per
+        # replica, here tracking the worker's shm batch slots. Retirement /
+        # death closes it, waking any waiter with SlotsClosed.
+        self.slots = SlotPool(n_slots)
         self.is_ready = False
         self.retired = False
         self.reader_started = False
@@ -380,7 +385,6 @@ class DeferredPool:
         self._next_wid = 0
         self._loop: asyncio.AbstractEventLoop | None = None
         self._lock: asyncio.Lock | None = None
-        self._slot_waiters: dict[int, asyncio.Event] = {}
         self._spawning = 0  # background replenish spawns in flight
         self._stopping = False
         self._bg_tasks: set = set()
@@ -528,8 +532,7 @@ class DeferredPool:
                 except Exception:
                     # A failed write must not leak the popped slot: the
                     # worker is still serving other batches.
-                    w.free_slots.append(slot)
-                    self._wake_slot_waiter(w)
+                    w.slots.release(slot)
                     raise
                 if not wrote or w.retired or not w.proc.is_alive():
                     continue
@@ -615,13 +618,14 @@ class DeferredPool:
             return self._spawn_ready()
 
     async def _take_slot(self, w: _Worker) -> int:
-        while not w.free_slots:
-            ev = asyncio.Event()
-            self._slot_waiters[w.wid] = ev
-            await ev.wait()
-            if w.retired or not w.proc.is_alive():
-                raise _WorkerGone()
-        return w.free_slots.pop()
+        try:
+            slot = await w.slots.acquire()
+        except SlotsClosed:
+            raise _WorkerGone() from None
+        if w.retired or not w.proc.is_alive():
+            w.slots.release(slot)
+            raise _WorkerGone()
+        return slot
 
     def _write_slot(self, w: _Worker, slot: int, host_batch: Any) -> bool:
         """Copy the batch into the worker's shm slot (executor thread).
@@ -670,19 +674,13 @@ class DeferredPool:
             w.conn.send({"op": "retire"})
         except (BrokenPipeError, OSError):
             pass
-        self._wake_slot_waiter(w)
-
-    def _wake_slot_waiter(self, w: _Worker) -> None:
-        ev = self._slot_waiters.pop(w.wid, None)
-        if ev:
-            ev.set()
+        w.slots.close()  # waiters re-route to a live worker (_WorkerGone)
 
     # -- worker messages (event loop) ----------------------------------------
     def _on_msg(self, w: _Worker, msg: dict) -> None:
         op = msg["op"]
         if op == "ack":
-            w.free_slots.append(msg["slot"])
-            self._wake_slot_waiter(w)
+            w.slots.release(msg["slot"])
         elif op == "results":
             self._scatter_results(w, msg)
         elif op == "died":
@@ -694,7 +692,7 @@ class DeferredPool:
             w.pending.clear()
             if self._active is w:
                 self._active = None
-            self._wake_slot_waiter(w)
+            w.slots.close()
             w.close()
 
     def _scatter_results(self, w: _Worker, msg: dict) -> None:

@@ -200,6 +200,32 @@ class ServingModel(abc.ABC):
             )
         return _stack_pad(items, b)
 
+    def assemble_into(self, items: list[Any], bucket: tuple, out: HostBatch) -> HostBatch:
+        """Assemble into a preallocated host-batch buffer (arena recycling).
+
+        ``out`` is a pytree of np arrays shaped like
+        ``input_signature(bucket)`` — the same host-batch contract the
+        deferred pool's shm slots rely on. Must produce exactly what
+        ``assemble`` would, writing in place: real rows copied, padded rows
+        zeroed. The batcher only uses this when it can prove equivalence
+        (``assemble`` not overridden, or ``assemble_into`` overridden
+        alongside it); families that customize ``assemble`` should override
+        this too to keep the allocation-free hot path."""
+        n = len(items)
+        if isinstance(items[0], tuple):
+            for k in range(len(items[0])):
+                comp = out[k]
+                for i, it in enumerate(items):
+                    comp[i] = it[k]
+                if n < comp.shape[0]:
+                    comp[n:] = 0
+            return out
+        for i, it in enumerate(items):
+            out[i] = it
+        if n < out.shape[0]:
+            out[n:] = 0
+        return out
+
     # -- parallelism --------------------------------------------------------
     def bind_mesh(self, mesh: Any) -> None:
         """Runtime hands the model its serving mesh before params/compile.

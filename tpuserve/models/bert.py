@@ -596,6 +596,16 @@ class BertServing(ServingModel):
         b, s = bucket
         ids = np.full((b, s), self.tokenizer.pad_id, np.int32)
         mask = np.zeros((b, s), np.int32)
+        return self._fill_ids_mask(items, s, ids, mask)
+
+    def assemble_into(self, items: list[np.ndarray], bucket: tuple, out) -> Any:
+        ids, mask = out
+        ids[:] = self.tokenizer.pad_id
+        mask[:] = 0
+        return self._fill_ids_mask(items, bucket[1], ids, mask)
+
+    @staticmethod
+    def _fill_ids_mask(items, s, ids, mask):
         for i, it in enumerate(items):
             n = min(it.shape[0], s)
             ids[i, :n] = it[:n]

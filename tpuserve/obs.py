@@ -7,7 +7,9 @@ p50/p99 latency. The build records:
 - counters (requests, errors, images served),
 - fixed-bucket latency histograms split by phase
   (queue / preproc / h2d / compute / total),
-- gauges (queue depth, batch fill ratio, in-flight batches),
+- gauges (queue depth, batch fill ratio, pipeline occupancy
+  ``pipeline_inflight{model=}``, per-stage executor queue depth
+  ``pipeline_stage_depth{model=,stage=}``),
 - a bounded ring buffer of request-scoped span events, dumpable as
   Chrome ``chrome://tracing`` JSON.
 
@@ -153,6 +155,13 @@ class Tracer:
 
 
 PHASES = ("queue", "preproc", "h2d", "compute", "postproc", "total")
+
+# Host-pipeline stage executors (tpuserve.hostpipe, docs/PERFORMANCE.md):
+# the stage label on pipeline_stage_depth{model=,stage=} and the keys of the
+# /stats "pipeline" block. One dedicated thread pool per stage; phase
+# histograms keep their own (overlapping) names above — "preproc" measures
+# the assemble stage, "compute" the fetch stage's dispatch-to-ready wait.
+PIPELINE_STAGES = ("assemble", "h2d", "fetch", "postproc")
 
 # Circuit-breaker states as gauge values (breaker_state{model=...}), chosen
 # so "bigger = less healthy" reads naturally on a dashboard.

@@ -72,6 +72,45 @@ class Executable:
     compiled: Any  # jax.stages.Compiled
     batch_sharding: Any  # pytree of NamedSharding for the batch input
     device_index: int = 0  # replica mode: which replica
+    donated: bool = False  # batch input buffers donated to the outputs
+
+
+def _leaves_with_shardings(struct: Any, shardings: Any) -> list[tuple]:
+    """Pair a ShapeDtypeStruct tree's leaves with their shardings;
+    ``shardings`` may be one NamedSharding broadcast over the tree."""
+    leaves = jax.tree_util.tree_leaves(struct)
+    if isinstance(shardings, NamedSharding):
+        return [(l, shardings) for l in leaves]
+    sh = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))
+    return list(zip(leaves, sh))
+
+
+def _donation_shapes_ok(batch_struct: Any, batch_shardings: Any,
+                        out_struct: Any, out_shardings: Any) -> bool:
+    """True when EVERY batch input buffer can alias a distinct output buffer
+    (same shape, dtype, and sharding spec). Donation is all-or-nothing on
+    purpose: partially-usable donation only produces "donated buffers were
+    not usable" warnings on every compile with no memory benefit (ADVICE r1,
+    which removed unconditional donation) — so the batch argument is donated
+    only when XLA can provably consume all of it."""
+    def key(leaf, sharding):
+        return (tuple(leaf.shape), str(jnp.dtype(leaf.dtype)),
+                str(getattr(sharding, "spec", sharding)))
+
+    outs: dict[tuple, int] = {}
+    for leaf, sh in _leaves_with_shardings(out_struct, out_shardings):
+        k = key(leaf, sh)
+        outs[k] = outs.get(k, 0) + 1
+    ins = _leaves_with_shardings(batch_struct, batch_shardings)
+    if not ins:
+        return False
+    for leaf, sh in ins:
+        k = key(leaf, sh)
+        if not outs.get(k):
+            return False
+        outs[k] -= 1
+    return True
 
 
 class ModelRuntime:
@@ -284,23 +323,40 @@ class ModelRuntime:
                     is_leaf=lambda x: isinstance(x, P),
                 )
             param_shardings = jax.tree_util.tree_map(lambda x: x.sharding, params)
-            # No donate_argnums: the uint8 input buffer can never alias the
-            # (different-dtype, different-shape) outputs, so donation only
-            # produced "donated buffers were not usable" warnings on every
-            # compile (ADVICE r1) with zero memory benefit.
-            jitted = jax.jit(
-                self._forward_fn(),
-                in_shardings=(param_shardings, in_batch_sharding),
-                out_shardings=out_shardings,
-            )
             params_struct = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), params
             )
+            # Donate the batch input only when every leaf provably aliases an
+            # output (shape+dtype+sharding match; _donation_shapes_ok) —
+            # typical classifiers (uint8 in, small float out) never qualify
+            # and compile warning-free (ADVICE r1). Never on the CPU backend:
+            # device_put there may alias host memory (the assembly arena),
+            # and a donated alias would let XLA scribble on a recycled
+            # arena buffer.
+            fwd = self._forward_fn()
+            donate = False
+            if jax.default_backend() != "cpu":
+                out_struct = jax.eval_shape(fwd, params_struct, batch_struct)
+                donate = _donation_shapes_ok(
+                    batch_struct, in_batch_sharding, out_struct, out_shardings)
+            jitted = jax.jit(
+                fwd,
+                in_shardings=(param_shardings, in_batch_sharding),
+                out_shardings=out_shardings,
+                donate_argnums=(1,) if donate else (),
+            )
             compiled = jitted.lower(params_struct, batch_struct).compile()
-            exes.append(Executable(bucket, compiled, in_batch_sharding, device_index=i))
+            exes.append(Executable(bucket, compiled, in_batch_sharding,
+                                   device_index=i, donated=donate))
         self.executables[bucket] = exes
 
     # -- hot path -----------------------------------------------------------
+    @property
+    def n_replicas(self) -> int:
+        """Independent executable sets (the batcher keeps a depth-k
+        staging-slot pool per replica)."""
+        return len(self.meshes)
+
     def pick_replica(self) -> int:
         if len(self.meshes) == 1:
             return 0
@@ -308,27 +364,42 @@ class ModelRuntime:
             self._rr = (self._rr + 1) % len(self.meshes)
             return self._rr
 
+    def h2d(self, bucket: tuple, host_batch: Any, replica: int = 0) -> Any:
+        """Transfer stage: ONE batched device_put of the whole host pytree
+        against the bucket's input shardings (a single transfer call, not a
+        tree_map of per-leaf puts). Runs on the pipeline's h2d executor."""
+        exe = self.executables[bucket][replica]
+        return jax.device_put(host_batch, exe.batch_sharding)
+
+    def dispatch(self, bucket: tuple, dev_batch: Any, replica: int = 0,
+                 params_override: list[Any] | None = None) -> Any:
+        """Compute stage: async-dispatch the compiled call against an
+        already-transferred device batch; returns device outputs immediately
+        (XLA async dispatch). Chaos kinds device_error/slow_compute fire
+        here — below the batcher — on both the run() and pipelined paths."""
+        if self.injector is not None:
+            delay = self.injector.delay_s("slow_compute", self.model.name)
+            if delay > 0:
+                time.sleep(delay)  # runs on a stage executor thread
+            self.injector.check("device_error", self.model.name)
+        exe = self.executables[bucket][replica]
+        params = (params_override if params_override is not None
+                  else self.params_per_mesh)
+        return exe.compiled(params[replica], dev_batch)
+
     def run(self, bucket: tuple, host_batch: Any, replica: int | None = None,
             params_override: list[Any] | None = None) -> Any:
-        """H2D + async dispatch. Returns device output pytree immediately.
+        """H2D + async dispatch in one call (h2d -> dispatch). Returns the
+        device output pytree immediately.
 
         ``params_override`` (a per-mesh tree list shaped like
         ``params_per_mesh``) runs this batch against a DIFFERENT weight tree
         than the published one — the lifecycle's staged canary executes the
         candidate version through the real compiled executables without it
         ever serving traffic."""
-        if self.injector is not None:
-            delay = self.injector.delay_s("slow_compute", self.model.name)
-            if delay > 0:
-                time.sleep(delay)  # runs in the batcher's threadpool
-            self.injector.check("device_error", self.model.name)
-        exes = self.executables[bucket]
         i = replica if replica is not None else self.pick_replica()
-        exe = exes[i]
-        params = (params_override if params_override is not None
-                  else self.params_per_mesh)
-        dev_batch = jax.tree_util.tree_map(jax.device_put, host_batch, exe.batch_sharding)
-        return exe.compiled(params[i], dev_batch)
+        return self.dispatch(bucket, self.h2d(bucket, host_batch, i), i,
+                             params_override=params_override)
 
     @staticmethod
     def fetch(outputs: Any) -> Any:

@@ -49,6 +49,7 @@ from tpuserve import models as modelzoo
 from tpuserve.batcher import DeadlineExceeded, ModelBatcher, QueueFull
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
+from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
 from tpuserve.obs import Metrics
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
@@ -68,6 +69,10 @@ class ServerState:
         self.cfg = cfg
         self.metrics = Metrics(cfg.trace_capacity)
         self.pool = cf.ThreadPoolExecutor(max_workers=cfg.decode_threads, thread_name_prefix="tpuserve")
+        # Pipelined host execution engine (tpuserve.hostpipe): one dedicated
+        # thread pool per stage, shared across every model's batcher so work
+        # is scheduled at stage granularity (docs/PERFORMANCE.md).
+        self.stages = StageExecutors(cfg.pipeline, self.metrics)
         self.models: dict[str, object] = {}
         self.runtimes: dict[str, ModelRuntime] = {}
         self.batchers: dict[str, ModelBatcher] = {}
@@ -128,7 +133,9 @@ class ServerState:
                                 retry_after_s=model.cfg.breaker_retry_after_s)
             self.breakers[name] = br
             b = ModelBatcher(model, rt, self.metrics, self.pool,
-                             breaker=br, injector=self.injector)
+                             breaker=br, injector=self.injector,
+                             stages=self.stages,
+                             pipeline_cfg=self.cfg.pipeline)
             await b.start()
             self.batchers[name] = b
             self.watchdog.register(name, "group_loop", b.revive_group_loops)
@@ -267,6 +274,7 @@ class ServerState:
         for rt in self.runtimes.values():
             if hasattr(rt, "enqueue"):
                 await rt.stop()
+        self.stages.shutdown()
         self.pool.shutdown(wait=False, cancel_futures=True)
 
 
@@ -419,6 +427,13 @@ async def handle_stats(request: web.Request) -> web.Response:
     if state.lifecycles:
         out["lifecycle"] = {n: lc.describe()
                             for n, lc in state.lifecycles.items()}
+    # Host-pipeline state (docs/PERFORMANCE.md "Reading the metrics"):
+    # per-stage executor sizes/queue depth and, per model, the in-flight
+    # occupancy, staging-slot usage, and assembly-arena recycling stats.
+    out["pipeline"] = {
+        "stages": state.stages.stats(),
+        "models": {n: b.pipeline_stats() for n, b in state.batchers.items()},
+    }
     return web.json_response(out)
 
 
