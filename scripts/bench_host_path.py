@@ -29,8 +29,6 @@ Usage: python scripts/bench_host_path.py   (prints one JSON line; ~2 min)
 
 from __future__ import annotations
 
-import asyncio
-import io
 import json
 import os
 import subprocess

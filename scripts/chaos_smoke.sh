@@ -3,8 +3,12 @@
 # slow tests) under forced-CPU JAX. Intended for CI and pre-merge runs;
 # see docs/ROBUSTNESS.md.
 set -euo pipefail
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 export JAX_PLATFORMS=cpu
+# Arm the runtime lock-order witness (docs/ANALYSIS.md): every suite and
+# drill below doubles as a race-detection pass — an AB/BA inversion or a
+# threading lock held across an await raises and fails the run.
+export TPUSERVE_LOCK_WITNESS=1
 
 echo "== tier-1 (fast, -m 'not slow') =="
 python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \

@@ -6,8 +6,11 @@
 # recycling with zero overflow. Run by CI next to the chaos/reload drills;
 # see docs/PERFORMANCE.md "Reading the metrics".
 set -euo pipefail
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): witnessed locks +
+# per-suspension held-lock checks; a violation raises and fails the smoke.
+export TPUSERVE_LOCK_WITNESS=1
 
 python - <<'EOF'
 import asyncio

@@ -6,8 +6,11 @@
 # scripts/chaos_smoke.sh and the CI workflow; see docs/ROBUSTNESS.md
 # "Model lifecycle & rollback".
 set -euo pipefail
-cd "$(dirname "$0")/.."
+cd "$(dirname "$0")/.." || exit 1
 export JAX_PLATFORMS=cpu
+# The reload drill runs witnessed (docs/ANALYSIS.md): hot-swap under load is
+# exactly where a lifecycle-vs-runtime lock inversion would hide.
+export TPUSERVE_LOCK_WITNESS=1
 
 cfg="$(mktemp -t reload_drill_cfg_XXXX)"
 out="$(mktemp -t reload_drill_out_XXXX)"

@@ -1,0 +1,54 @@
+"""TPS101/TPS102 fixture: blocking calls in (or reachable from) async code.
+
+Not imported by anything — parsed by tests/test_analysis.py through the
+analyzer. ``bad_*`` symbols must be flagged; ``good_*`` must not.
+"""
+
+import asyncio
+import threading
+import time
+
+
+class Handler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+
+    async def bad_sleep(self):
+        time.sleep(0.1)  # TPS101: blocks the event loop
+
+    async def bad_result(self, fut):
+        return fut.result()  # TPS101: sync future wait on the loop
+
+    async def bad_acquire(self):
+        self._lock.acquire()  # TPS101: blocking acquire of a threading lock
+
+    async def bad_held_across_await(self):
+        with self._lock:  # TPS102: threading lock held across await
+            await asyncio.sleep(0)
+
+    async def bad_reachable(self):
+        self._helper()  # TPS101: helper blocks, called directly on the loop
+
+    def _helper(self):
+        time.sleep(0.5)
+
+    async def good_async_lock(self):
+        async with self._alock:  # asyncio locks may span awaits
+            await asyncio.sleep(0)
+
+    async def good_awaited(self):
+        await asyncio.sleep(0.1)
+
+    async def good_executor(self, loop, pool):
+        # A reference handed to an executor is not a call edge.
+        await loop.run_in_executor(pool, self._helper)
+
+    async def good_lock_released_before_await(self):
+        with self._lock:
+            x = 1
+        await asyncio.sleep(0)
+        return x
+
+    def good_sync_sleep(self):
+        time.sleep(0.1)  # sync helper never called from an async body here

@@ -1,0 +1,54 @@
+"""TPS201 fixture: AB/BA lock-order inversions, nested and via a call."""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:  # TPS201: closes the a->b / b->a cycle
+                pass
+
+
+class CrossCall:
+    def __init__(self):
+        self._m = threading.Lock()
+        self._n = threading.Lock()
+
+    def outer(self):
+        with self._m:
+            self.inner()  # acquires n while m is held (call edge)
+
+    def inner(self):
+        with self._n:
+            pass
+
+    def reversed_order(self):
+        with self._n:
+            with self._m:  # TPS201: n->m against the m->n call edge
+                pass
+
+
+class Ordered:
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def one(self):
+        with self._x:
+            with self._y:
+                pass
+
+    def two(self):
+        with self._x:
+            with self._y:  # same order everywhere: clean
+                pass

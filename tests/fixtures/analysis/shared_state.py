@@ -1,0 +1,62 @@
+"""TPS301 fixture: instance state written from executor threads AND the
+event loop — with and without a common lock (including a guard held by the
+caller rather than at the write site, which must count)."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self.items = []
+        self.count = 0
+
+    def kick(self, loop, pool):
+        loop.run_in_executor(pool, self._work)
+
+    def _work(self):
+        self.items.append(1)  # TPS301: executor-thread write, no lock
+        self.count += 1  # TPS301
+
+    async def serve(self):
+        self.items.pop()  # the loop-side half of the race
+        self.count -= 1
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+
+    def kick(self, loop, pool):
+        loop.run_in_executor(pool, self._work)
+
+    def _work(self):
+        with self._lock:
+            self.items.append(1)
+
+    async def serve(self):
+        with self._lock:
+            self.items.pop()
+
+
+class EntryHeld:
+    """The guard is held by every CALLER of the mutator, never lexically at
+    the write site — context propagation must still see it as guarded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.roster = []
+
+    def kick(self, loop, pool):
+        loop.run_in_executor(pool, self._thread_side)
+
+    def _thread_side(self):
+        with self._lock:
+            self._mutate()
+
+    def _mutate(self):
+        self.roster.append(1)
+
+    async def serve(self):
+        with self._lock:
+            self._mutate()

@@ -1,0 +1,171 @@
+"""Static-analysis suite (ISSUE 4): every rule family against the fixture
+snippets under tests/fixtures/analysis/ (positive AND negative cases), the
+drift rules against a synthetic mini-repo, the baseline workflow, and the
+real tree staying clean vs the checked-in baseline."""
+
+import json
+from pathlib import Path
+
+from tpuserve.analysis import astlint, drift
+from tpuserve.analysis.findings import Finding, compare, load_baseline, save_baseline
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def run_fixture(name):
+    return astlint.run_paths([FIXTURES / name], FIXTURES)
+
+
+# ---------------------------------------------------------------------------
+# TPS101 / TPS102: blocking on the event loop
+# ---------------------------------------------------------------------------
+
+def test_blocking_in_async_positive_cases():
+    found = {(f.rule, f.symbol) for f in run_fixture("async_blocking.py")}
+    assert ("TPS101", "Handler.bad_sleep") in found
+    assert ("TPS101", "Handler.bad_result") in found
+    assert ("TPS101", "Handler.bad_acquire") in found
+    assert ("TPS102", "Handler.bad_held_across_await") in found
+
+
+def test_blocking_reachable_through_sync_helper():
+    hits = [f for f in run_fixture("async_blocking.py")
+            if f.symbol == "Handler.bad_reachable"]
+    assert hits, "blocking helper called from async body not flagged"
+    assert "_helper" in hits[0].message  # the path is named
+
+
+def test_blocking_negative_cases():
+    bad = [f for f in run_fixture("async_blocking.py") if "good_" in f.symbol]
+    assert not bad, [f.render() for f in bad]
+
+
+# ---------------------------------------------------------------------------
+# TPS201: lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_lock_order_inversion_detected():
+    cycles = [f for f in run_fixture("lock_order.py") if f.rule == "TPS201"]
+    nested = [f for f in cycles if "Inverted._a" in f.symbol]
+    assert nested, [f.render() for f in cycles]
+    # Both directions' acquisition sites are named in the message.
+    assert "one" in nested[0].message and "two" in nested[0].message
+
+
+def test_lock_order_call_edge_detected():
+    cycles = [f for f in run_fixture("lock_order.py")
+              if f.rule == "TPS201" and "CrossCall" in f.symbol]
+    assert cycles, "m->n edge created through a call while m held was missed"
+
+
+def test_lock_order_consistent_ordering_clean():
+    assert not [f for f in run_fixture("lock_order.py") if "Ordered" in f.symbol]
+
+
+# ---------------------------------------------------------------------------
+# TPS301: unguarded cross-context writes
+# ---------------------------------------------------------------------------
+
+def test_shared_state_race_detected():
+    found = {f.symbol for f in run_fixture("shared_state.py")
+             if f.rule == "TPS301"}
+    assert "Racy.items" in found and "Racy.count" in found, found
+
+
+def test_shared_state_guarded_and_entry_held_clean():
+    found = {f.symbol for f in run_fixture("shared_state.py")
+             if f.rule == "TPS301"}
+    assert not any("Guarded" in s or "EntryHeld" in s for s in found), found
+
+
+# ---------------------------------------------------------------------------
+# TPS4xx drift rules (synthetic mini-repo so the cases are hermetic)
+# ---------------------------------------------------------------------------
+
+def _mini_repo(tmp_path, *, document=False):
+    (tmp_path / "tpuserve").mkdir()
+    (tmp_path / "examples").mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tpuserve" / "config.py").write_text(
+        "from dataclasses import dataclass\n"
+        'FAULT_KINDS = ("boom",)\n'
+        "@dataclass\n"
+        "class ModelConfig:\n"
+        "    knob_a: int = 1\n"
+        "    knob_b: int = 2\n"
+    )
+    (tmp_path / "tpuserve" / "obs.py").write_text(
+        'class M:\n    def f(self, m):\n        m.counter(f"widgets_total{x}").inc()\n'
+    )
+    toml = "knob_a = 1\n" + ("knob_b = 2\n" if document else "")
+    (tmp_path / "examples" / "serve_all.toml").write_text(toml)
+    docs = "knob_a knob_b\n" if document else "knob_a\n"
+    if document:
+        docs += "widgets_total\n"
+    (tmp_path / "README.md").write_text(docs)
+    (tmp_path / "tests" / "test_x.py").write_text(
+        'KIND = "boom"\n' if document else "pass\n")
+    return tmp_path
+
+
+def test_drift_rules_flag_missing(tmp_path):
+    found = {(f.rule, f.symbol) for f in drift.run(_mini_repo(tmp_path))}
+    assert ("TPS401", "ModelConfig.knob_b") in found
+    assert ("TPS402", "metric.widgets_total") in found
+    assert ("TPS403", "fault.boom") in found
+    assert not any(s == "ModelConfig.knob_a" for _r, s in found)
+
+
+def test_drift_rules_clean_when_documented(tmp_path):
+    assert drift.run(_mini_repo(tmp_path, document=True)) == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline workflow
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip_and_compare(tmp_path):
+    old = Finding(rule="TPS101", file="a.py", symbol="f", message="m", line=3)
+    new = Finding(rule="TPS201", file="b.py", symbol="g", message="n", line=9)
+    path = tmp_path / "baseline.json"
+    save_baseline(path, [old])
+    baseline = load_baseline(path)
+    fresh, stale = compare([old, new], baseline)
+    assert fresh == [new] and not stale
+    # Line numbers are not identity: the same finding moved does not re-fail.
+    moved = Finding(rule="TPS101", file="a.py", symbol="f", message="m", line=99)
+    fresh, stale = compare([moved], baseline)
+    assert not fresh and not stale
+    # A fixed finding surfaces as a stale baseline entry, never silently.
+    fresh, stale = compare([], baseline)
+    assert not fresh and stale == {old.key}
+
+
+def test_baseline_file_is_valid_json():
+    data = json.loads((ROOT / "tpuserve" / "analysis" / "baseline.json").read_text())
+    assert isinstance(data["findings"], list)
+
+
+# ---------------------------------------------------------------------------
+# The real tree: lint must run clean against the checked-in baseline (the
+# same gate CI runs via `python -m tpuserve lint`).
+# ---------------------------------------------------------------------------
+
+def test_repo_lint_clean_vs_baseline():
+    findings = astlint.run_paths(
+        astlint.collect_files([ROOT / "tpuserve"]), ROOT)
+    findings += drift.run(ROOT)
+    baseline = load_baseline(ROOT / "tpuserve" / "analysis" / "baseline.json")
+    new, _stale = compare(findings, baseline)
+    assert not new, "new lint findings:\n" + "\n".join(f.render() for f in new)
+
+
+def test_lint_cli_exit_codes(tmp_path):
+    from tpuserve.cli import main
+
+    assert main(["lint"]) == 0
+    # --no-baseline over the fixtures must fail (they are all positives).
+    assert main(["lint", "--no-baseline", str(FIXTURES)]) == 1
+    assert main(["lint", str(tmp_path / "missing")]) == 2

@@ -502,6 +502,39 @@ def test_device_error_below_batcher_retried(loop):
     loop.run_until_complete(go())
 
 
+def test_slow_compute_below_batcher_still_serves(loop):
+    """slow_compute injects a sleep inside ModelRuntime.dispatch — on a
+    stage-executor thread, below the batcher. The request must still answer
+    200, just slower, and the injected delay must show up in the dispatch
+    wall time (the fault existed since ISSUE 1 but had no test: surfaced by
+    `tpuserve lint` TPS403)."""
+    cfg = toy_server_cfg(startup_canary=False,
+                         faults=FaultsConfig(enabled=True, rules=[
+                             FaultRuleConfig(kind="slow_compute", model="toy",
+                                             count=1, delay_ms=300.0)]))
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def go():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            t0 = time.perf_counter()
+            r = await client.post("/v1/models/toy:predict",
+                                  data=npy_image(), headers=NPY)
+            elapsed = time.perf_counter() - t0
+            assert r.status == 200, await r.text()
+            assert elapsed >= 0.3, elapsed  # the injected sleep was real
+            snap = state.injector.snapshot()
+            fired = [r for r in snap if r["kind"] == "slow_compute"]
+            assert fired and fired[0]["fired"] == 1, snap
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
 def test_decode_corrupt_maps_to_400(loop):
     cfg = toy_server_cfg(faults=FaultsConfig(enabled=True, rules=[
         FaultRuleConfig(kind="decode_corrupt", count=1)]))

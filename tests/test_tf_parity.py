@@ -12,7 +12,6 @@ minutes — it is the integration proof that real TF weight artifacts serve
 correctly, not a unit test.
 """
 
-import os
 
 import numpy as np
 import pytest
@@ -236,7 +235,7 @@ def test_int8c_accuracy_on_imported_bert(bert_savedmodel):
     out_c = serve("int8c")
     assert (out_c["indices"][0][0] == out_fp["indices"][0][0]).all()
     drift = float(np.abs(out_c["probs"] - out_fp["probs"]).max())
-    print(f"# int8c-vs-f32 on imported BERT: top-1 equal, "
+    print("# int8c-vs-f32 on imported BERT: top-1 equal, "
           f"max prob drift {drift:.4f}")
     assert drift < 3e-2
 
@@ -323,7 +322,7 @@ def test_int8_accuracy_on_imported_weights(keras_savedmodel):
     p_int8 = np.asarray(jax.nn.softmax(y_int8, axis=-1))
     drift = float(np.abs(p_int8 - p_bf16).max())
     rel_logit = float(np.abs(y_int8 - y_bf16).max() / np.abs(y_bf16).max())
-    print(f"# int8-vs-bf16 on imported ResNet-50: top-1 equal, "
+    print("# int8-vs-bf16 on imported ResNet-50: top-1 equal, "
           f"max prob drift {drift:.4f}, rel logit drift {rel_logit:.4f}")
     assert (y_int8.argmax(-1) == y_bf16.argmax(-1)).all()
     assert drift < 1e-2, drift  # "sub-percent movement", measured not claimed

@@ -1,0 +1,12 @@
+"""Concurrency static analysis + runtime lock-order witness (ISSUE 4).
+
+- ``tpuserve.analysis.astlint`` — AST rule families over the serving path
+  (blocking-in-async, lock-order cycles, unguarded cross-thread writes).
+- ``tpuserve.analysis.drift`` — docs/config/test drift rules.
+- ``tpuserve.analysis.witness`` — TPUSERVE_LOCK_WITNESS=1 runtime witness.
+- ``tpuserve.analysis.cli`` — ``python -m tpuserve lint`` entry point, with
+  the checked-in baseline at ``tpuserve/analysis/baseline.json``.
+
+Kept import-light on purpose: ``python -m tpuserve lint`` must run on a bare
+Python (CI lint job) with none of the serving dependencies installed.
+"""

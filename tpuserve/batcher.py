@@ -300,7 +300,7 @@ class ModelBatcher:
                 continue
             if r.deadline_at is not None and now >= r.deadline_at:
                 r.future.set_exception(DeadlineExceeded(
-                    f"deadline expired after "
+                    "deadline expired after "
                     f"{(now - r.enqueued_at) * 1e3:.0f} ms in queue"))
                 n_expired += 1
                 if adjust_pending:

@@ -8,6 +8,7 @@ Usage::
                               [--drill reload]
     python -m tpuserve import-model --saved-model DIR --family resnet50 --out CKPT
     python -m tpuserve warmup --config serve.toml   (compile + persist XLA cache)
+    python -m tpuserve lint                          (concurrency/drift analysis)
     python -m tpuserve describe                      (device/mesh inventory)
 """
 
@@ -114,6 +115,15 @@ def main(argv: list[str] | None = None) -> int:
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
 
+    p_lint = sub.add_parser(
+        "lint",
+        help="concurrency + drift static analysis over tpuserve/ "
+             "(docs/ANALYSIS.md); fails on findings not in the checked-in "
+             "baseline")
+    from tpuserve.analysis.cli import add_lint_args
+
+    add_lint_args(p_lint)
+
     sub.add_parser("describe", help="print device / mesh inventory")
 
     args = parser.parse_args(argv)
@@ -186,6 +196,11 @@ def main(argv: list[str] | None = None) -> int:
                                  dataset=args.data)
         print(json.dumps({"final_loss": loss, "checkpoint": args.out}))
         return 0
+
+    if args.cmd == "lint":
+        from tpuserve.analysis.cli import run_lint
+
+        return run_lint(args)
 
     if args.cmd == "warmup":
         from tpuserve.config import default_config, load_config

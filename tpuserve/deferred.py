@@ -52,6 +52,31 @@ Protocol (pipe carries control, shared memory carries data):
     ◀─ {"op":"results", shm, shapes} ──   rows in a results shm it created
     scatter rows to batch futures
     ── {"op":"bye"} ──────────────────▶   unlink results shm, exit
+
+Lock & thread-ownership map (three lock families on purpose; enforced by
+``python -m tpuserve lint`` TPS301 and the TPUSERVE_LOCK_WITNESS runtime
+witness — docs/ANALYSIS.md):
+
+- **Event-loop-owned, no lock**: ``_active``, per-worker ``pending`` /
+  ``rows_used`` / ``first_batch_t`` / ``retired`` / ``reader_started``,
+  ``stats``, ``_spawning``, ``_bg_tasks``. Mutated only from coroutines or
+  loop callbacks (``_on_msg`` arrives via ``call_soon_threadsafe``).
+- **``_lock`` (asyncio.Lock, loop only)**: serializes ``enqueue`` end to
+  end — slot pop, shm write (hopped to the executor WHILE the lock stays
+  held, which is legal for an asyncio lock and exactly why it is not a
+  threading lock), epoch bookkeeping, and the batch send.
+- **``_roster_lock`` (threading, microseconds)**: guards the worker roster
+  — ``_workers``, ``_warm``, ``_next_wid`` — which is mutated from BOTH the
+  loop (``_next_warm`` via ``_ensure_active``, ``watchdog_sweep``) and
+  executor threads (``_dry_acquire`` / ``_spawn_blocking`` replenish paths).
+  Never held across anything slow.
+- **``_spawn_mutex`` (threading, seconds)**: serializes worker *spawns*
+  (concurrent ``Process.start()`` from two threads races pipe fds). Taken
+  only on executor threads, never on the loop; may nest ``_roster_lock``
+  inside it (spawn -> roster is the one sanctioned order), never the
+  reverse.
+- **``_PinnedShm`` internal lock (threading)**: pin/unpin/close accounting,
+  taken from both the loop (close at retirement) and slot-writer threads.
 """
 
 from __future__ import annotations
@@ -70,6 +95,7 @@ import numpy as np
 
 from tpuserve.config import ModelConfig
 from tpuserve.hostpipe import SlotPool, SlotsClosed
+from tpuserve.utils.locks import new_async_lock, new_lock
 
 log = logging.getLogger("tpuserve.deferred")
 
@@ -258,7 +284,7 @@ class _PinnedShm:
 
     def __init__(self, size: int) -> None:
         self._shm = shared_memory.SharedMemory(create=True, size=size)
-        self._lock = threading.Lock()
+        self._lock = new_lock("deferred.pinned_shm")
         self._writes = 0
         self._close_requested = False
         self._released = False
@@ -390,8 +416,14 @@ class DeferredPool:
         self._bg_tasks: set = set()
         # Serializes worker spawns across executor threads: concurrent
         # multiprocessing Process.start() from two threads races pipe fds
-        # (children die at startup with EOF on the ready handshake).
-        self._spawn_mutex = threading.Lock()
+        # (children die at startup with EOF on the ready handshake). Slow
+        # (seconds); executor threads only — never taken on the event loop.
+        self._spawn_mutex = new_lock("deferred.spawn")
+        # Guards the worker roster (_workers/_warm/_next_wid), which both
+        # the loop and replenish threads mutate (see the module docstring's
+        # ownership map; the old unguarded lists were a real pop-vs-remove
+        # race surfaced by `tpuserve lint` TPS301). Microsecond hold times.
+        self._roster_lock = new_lock("deferred.roster")
         self.stats = {"epochs": 0, "read_s_total": 0.0, "worker_respawns": 0,
                       "workers_prespawned": 0, "rows_total": 0}
 
@@ -402,11 +434,13 @@ class DeferredPool:
         n = n or self.n_workers
         first = self._spawn()
         self._wait_ready_sync(first)
-        self._warm.append(first)
+        with self._roster_lock:
+            self._warm.append(first)
         rest = [self._spawn() for _ in range(n - 1)]
         for w in rest:
             self._wait_ready_sync(w)
-            self._warm.append(w)
+            with self._roster_lock:
+                self._warm.append(w)
 
     def _spawn(self) -> _Worker:
         """Start a worker process. NOT added to ``_warm`` here: a warming
@@ -415,10 +449,13 @@ class DeferredPool:
         its batch shm under the still-starting child, which then dies with
         FileNotFoundError at attach (observed live in r5 verify). Callers
         append to ``_warm`` only after the ready handshake."""
+        with self._roster_lock:
+            wid = self._next_wid
+            self._next_wid += 1
         w = _Worker(self.mcfg, self.cache_dir, self.slot_bytes, self.n_slots,
-                    self.cap_rows, self._next_wid)
-        self._next_wid += 1
-        self._workers.append(w)
+                    self.cap_rows, wid)
+        with self._roster_lock:
+            self._workers.append(w)
         return w
 
     def _spawn_ready(self) -> _Worker:
@@ -430,11 +467,13 @@ class DeferredPool:
         try:
             self._wait_ready_sync(w)
         except Exception:
-            if w in self._workers:
-                self._workers.remove(w)
+            with self._roster_lock:
+                if w in self._workers:
+                    self._workers.remove(w)
             w.close()
             raise
-        self._warm.append(w)
+        with self._roster_lock:
+            self._warm.append(w)
         return w
 
     def _wait_ready_sync(self, w: _Worker, timeout: float = 900.0) -> None:
@@ -447,16 +486,22 @@ class DeferredPool:
         raise TimeoutError(f"worker {w.wid} not ready after {timeout}s")
 
     def _next_warm(self) -> _Worker | None:
-        while self._warm:
-            w = self._warm.pop(0)
+        """Pop the next live warm worker. Called from the loop
+        (_ensure_active) AND from replenish threads (_dry_acquire): every
+        pop goes through the roster lock; the slow close() of a dead
+        candidate happens outside it."""
+        while True:
+            with self._roster_lock:
+                if not self._warm:
+                    return None
+                w = self._warm.pop(0)
             if w.is_ready and w.proc.is_alive():
                 return w
             w.close()
-        return None
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
-        self._lock = asyncio.Lock()
+        self._lock = new_async_lock("deferred.enqueue")
         for w in self._workers:
             self._start_reader(w)
 
@@ -503,7 +548,7 @@ class DeferredPool:
             raise ValueError(
                 f"batch totals {total} B but a shm slot holds "
                 f"{self.slot_bytes} B (sized for the largest configured "
-                f"bucket); enqueue batches padded to a configured bucket")
+                "bucket); enqueue batches padded to a configured bucket")
         if (self.injector is not None and self._active is not None
                 and self._active.proc.is_alive()
                 and self.injector.fire("worker_death", self.model.name)):
@@ -555,7 +600,7 @@ class DeferredPool:
 
     async def _ensure_active(self, bucket: tuple) -> _Worker:
         w = self._active
-        if w is not None and not w.retired and w.proc.is_alive() \
+        if w is not None and not w.retired and w.proc.is_alive()\
            and w.rows_used + bucket[0] <= self.cap_rows:
             return w
         if w is not None and not w.retired and w.proc.is_alive():
@@ -580,7 +625,8 @@ class DeferredPool:
             w = self._next_warm()
             if w is None:
                 w = self._spawn_ready()
-                self._warm.remove(w)
+                with self._roster_lock:
+                    self._warm.remove(w)
             return w
 
     def _maybe_replenish(self) -> None:
@@ -590,7 +636,9 @@ class DeferredPool:
         (measured ~13 s per rotation on the dev tunnel once the initial
         pool drained)."""
         target = max(1, self.n_workers - 1)  # spares beyond the active one
-        alive_warm = sum(1 for w in self._warm
+        with self._roster_lock:
+            warm = list(self._warm)
+        alive_warm = sum(1 for w in warm
                          if w.is_ready and w.proc.is_alive())
         if self._stopping or alive_warm + self._spawning >= target:
             return
@@ -643,7 +691,7 @@ class DeferredPool:
             raise ValueError(
                 f"batch totals {total} B but a shm slot holds "
                 f"{self.slot_bytes} B (sized for the largest configured "
-                f"bucket); enqueue batches padded to a configured bucket")
+                "bucket); enqueue batches padded to a configured bucket")
         if not w.batch_shm.pin():
             return False
         try:
@@ -725,6 +773,8 @@ class DeferredPool:
 
     # -- admin ---------------------------------------------------------------
     def describe(self) -> dict:
+        with self._roster_lock:
+            workers, n_warm = list(self._workers), len(self._warm)
         return {
             "model": self.model.name,
             "family": self.mcfg.family,
@@ -734,8 +784,8 @@ class DeferredPool:
             "weights": self.mcfg.weights,
             "labels": self.mcfg.labels,
             "options": dict(self.mcfg.options),
-            "workers_alive": len([w for w in self._workers if w.proc.is_alive()]),
-            "warm": len(self._warm),
+            "workers_alive": len([w for w in workers if w.proc.is_alive()]),
+            "warm": n_warm,
             "epoch_images": self.cap_rows,
             "epoch_ms": self.mcfg.relay_epoch_ms,
             "buckets": [list(b) for b in self.model.buckets()],
@@ -752,20 +802,26 @@ class DeferredPool:
         ``_workers``). Returns how many UN-retired workers were found dead —
         real failures; retired workers exiting is normal lifecycle."""
         died = 0
-        for w in list(self._workers):
+        with self._roster_lock:
+            workers = list(self._workers)
+        for w in workers:
             if w.proc.is_alive():
                 continue
-            if not w.retired and (w.pending or w in self._warm
+            with self._roster_lock:
+                was_warm = w in self._warm
+            if not w.retired and (w.pending or was_warm
                                   or w is self._active):
                 died += 1
                 self._on_msg(w, {"op": "died",
                                  "error": "watchdog: process not alive"})
             if not w.pending:
-                if w in self._warm:
-                    self._warm.remove(w)
+                with self._roster_lock:
+                    if w in self._warm:
+                        self._warm.remove(w)
+                    if w in self._workers:
+                        self._workers.remove(w)
                 if self._active is w:
                     self._active = None
-                self._workers.remove(w)
                 w.close()
         if not self._stopping and self._loop is not None:
             self._maybe_replenish()
@@ -777,7 +833,9 @@ class DeferredPool:
         Called at the start of server shutdown so batch futures resolve in
         readback time instead of at the epoch deadline; safe to call more
         than once."""
-        for w in self._workers:
+        with self._roster_lock:
+            workers = list(self._workers)
+        for w in workers:
             if w.proc.is_alive() and not w.retired and w.pending:
                 self._retire(w)
                 if self._active is w:
@@ -789,13 +847,17 @@ class DeferredPool:
         died' (ADVICE r2: the old 50 ms grace stranded every real epoch)."""
         self._stopping = True  # in-flight background spawns self-close
         self.retire_active()
-        waiting = [w for w in self._workers if w.pending]
+        with self._roster_lock:
+            workers = list(self._workers)
+        waiting = [w for w in workers if w.pending]
         deadline = self._loop.time() + max(5.0, 2.0 * self.epoch_s)
         while waiting and self._loop.time() < deadline:
             await asyncio.sleep(0.05)
             waiting = [w for w in waiting if w.pending]
         err = RuntimeError("deferred pool stopped before epoch readback")
-        for w in self._workers:
+        with self._roster_lock:
+            workers = list(self._workers)
+        for w in workers:
             for pb in w.pending:
                 if not pb.future.done():
                     pb.future.set_exception(err)

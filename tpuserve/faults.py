@@ -38,11 +38,11 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-import threading
 from typing import Callable
 
 from tpuserve.config import FaultRuleConfig, FaultsConfig
 from tpuserve.obs import BREAKER_STATES, Metrics
+from tpuserve.utils.locks import new_lock
 
 log = logging.getLogger("tpuserve.faults")
 
@@ -83,7 +83,7 @@ class FaultInjector:
     def __init__(self, cfg: FaultsConfig, metrics: Metrics | None = None) -> None:
         self.cfg = cfg
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = new_lock("faults.FaultInjector")
         # Derived seeds keep distinct rules decorrelated even when the
         # operator leaves every rule.seed at 0.
         self._rules = [_ArmedRule(r, cfg.seed * 1000003 + i + 1)
@@ -158,7 +158,7 @@ class CircuitBreaker:
         self.threshold = threshold
         self.metrics = metrics
         self.retry_after_s = retry_after_s
-        self._lock = threading.Lock()
+        self._lock = new_lock("faults.CircuitBreaker")
         self.state = "closed"
         self.consecutive_errors = 0
         self.opened_total = 0

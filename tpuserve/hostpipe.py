@@ -32,7 +32,6 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures as cf
 import logging
-import threading
 from collections import deque
 from typing import Any, Callable
 
@@ -41,6 +40,7 @@ import numpy as np
 
 from tpuserve.config import PipelineConfig
 from tpuserve.obs import PIPELINE_STAGES, Metrics
+from tpuserve.utils.locks import new_lock
 
 log = logging.getLogger("tpuserve.hostpipe")
 
@@ -209,7 +209,7 @@ class AssemblyArena:
         self.model = model
         self.slots = max(1, slots)
         self.metrics = metrics
-        self._lock = threading.Lock()
+        self._lock = new_lock("hostpipe.AssemblyArena")
         self._free: dict[tuple, list] = {}
         self._made: dict[tuple, int] = {}
         self.overflow_total = 0

@@ -47,6 +47,7 @@ from tpuserve.config import LifecycleConfig
 from tpuserve.obs import Metrics
 from tpuserve.runtime import NaNDetected
 from tpuserve.savedmodel import IntegrityError
+from tpuserve.utils.locks import new_async_lock
 
 log = logging.getLogger("tpuserve.lifecycle")
 
@@ -95,7 +96,7 @@ class ModelLifecycle:
         # the soak monitor watches it without submitting extra probes.
         self._canary_status = canary_status
         self.injector = injector
-        self._lock = asyncio.Lock()
+        self._lock = new_async_lock("lifecycle.ModelLifecycle")
         self._soak_task: asyncio.Task | None = None
         # Version-transition records, newest last: {version, at, status,
         # ...detail}. status: live | superseded | rolled_back | rejected.
@@ -218,7 +219,7 @@ class ModelLifecycle:
         bad = [k for k, a in _np_leaves(out)
                if a.dtype.kind == "f" and not np.isfinite(a).all()]
         if bad:
-            raise ValueError(f"staged canary produced non-finite outputs "
+            raise ValueError("staged canary produced non-finite outputs "
                              f"in {bad}")
         results = self.model.host_postprocess(out, 1)
         if not results:
@@ -231,7 +232,7 @@ class ModelLifecycle:
             f"rollbacks_total{{model={self.name},reason={reason}}}").inc()
         self.metrics.set_model_version(self.name, self.runtime.version)
         for rec in reversed(self.history):
-            if rec["version"] == info["rolled_back_from"] \
+            if rec["version"] == info["rolled_back_from"]\
                     and rec["status"] in ("live", "superseded"):
                 rec["status"] = "rolled_back"
                 rec["reason"] = reason

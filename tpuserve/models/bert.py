@@ -225,7 +225,7 @@ class BertServing(ServingModel):
         if cfg.parallelism == "pipeline":
             if attention != "dense":
                 raise ValueError(
-                    f"parallelism='pipeline' supports options.attention="
+                    "parallelism='pipeline' supports options.attention="
                     f"'dense' only, got {attention!r}")
             if int(opt.get("moe_experts", 0)):
                 raise ValueError(

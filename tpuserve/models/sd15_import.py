@@ -268,7 +268,6 @@ def import_ldm_checkpoint(model, flat: dict[str, np.ndarray]) -> Any:
             f"under {missing}); SD 1.5 import expects the published "
             "v1-5-pruned*.safetensors / .ckpt layout")
 
-    o = model.cfg.options
     try:
         params = {
             "text": {"params": map_clip_text(

@@ -16,9 +16,10 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
 
 import numpy as np
+
+from tpuserve.utils.locks import new_lock
 
 log = logging.getLogger("tpuserve.native")
 
@@ -26,7 +27,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__fil
                            "native", "decode")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libjpegyuv.so")
 
-_lock = threading.Lock()
+_lock = new_lock("native.decoder")
 _lib = None
 _load_failed = False
 

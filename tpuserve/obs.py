@@ -23,11 +23,12 @@ from __future__ import annotations
 
 import json
 import math
-import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterable
+
+from tpuserve.utils.locks import new_lock
 
 
 def _default_latency_buckets() -> list[float]:
@@ -48,7 +49,7 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)
         self.total = 0.0
         self.n = 0
-        self._lock = threading.Lock()
+        self._lock = new_lock("obs.Histogram")
 
     def observe(self, value: float) -> None:
         i = 0
@@ -97,7 +98,7 @@ class Counter:
     def __init__(self, name: str) -> None:
         self.name = name
         self.value = 0.0
-        self._lock = threading.Lock()
+        self._lock = new_lock("obs.Counter")
 
     def inc(self, amount: float = 1.0) -> None:
         with self._lock:
@@ -129,7 +130,7 @@ class Tracer:
 
     def __init__(self, capacity: int = 65536) -> None:
         self._events: deque[SpanEvent] = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = new_lock("obs.Tracer")
 
     def add(self, name: str, start_s: float, end_s: float, tid: str = "main", **args) -> None:
         ev = SpanEvent(name, start_s * 1e6, (end_s - start_s) * 1e6, tid, args)
@@ -183,7 +184,7 @@ class Metrics:
     """Registry of all server metrics. One instance per server process."""
 
     def __init__(self, trace_capacity: int = 65536) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("obs.Metrics")
         self._histograms: dict[str, Histogram] = {}
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}

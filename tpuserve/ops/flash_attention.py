@@ -273,7 +273,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if blk != size and blk % 8:
             raise ValueError(
                 f"seq_{name} {size} only admits a {blk}-row {name} block, "
-                f"which the TPU lowering rejects; use a multiple of 8")
+                "which the TPU lowering rejects; use a multiple of 8")
     if interpret is None:
         # The effective platform, honoring `with jax.default_device(cpu)`
         # (the runtime pins param init there): default_backend() alone would

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import logging
-import threading
 import time
 from dataclasses import dataclass
 from typing import Any
@@ -45,6 +44,7 @@ from tpuserve.models.base import ServingModel
 from tpuserve.parallel import make_mesh, match_partition_rules
 from tpuserve.parallel.mesh import MeshPlan
 from tpuserve.parallel.partition import specs_to_shardings
+from tpuserve.utils.locks import new_lock
 
 log = logging.getLogger("tpuserve.runtime")
 
@@ -185,8 +185,8 @@ class ModelRuntime:
         self._prev_params: list[Any] | None = None
         self._prev_version: int | None = None
         self._rr = 0  # round-robin cursor for replica mode
-        self._rr_lock = threading.Lock()
-        self._reload_lock = threading.Lock()
+        self._rr_lock = new_lock("runtime.replica_rr")
+        self._reload_lock = new_lock("runtime.reload")
         # Deterministic chaos (tpuserve.faults.FaultInjector); None in prod.
         # Kinds "device_error"/"slow_compute" fire inside run() — below the
         # batcher — so retry/breaker behavior is proven against failures the

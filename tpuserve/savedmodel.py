@@ -79,10 +79,10 @@ def load_params_for(model) -> Any:
                 # unpickling trace (ADVICE r4).
                 raise ValueError(
                     f"cannot identify weight format of {path!r}: tried the "
-                    f"torch loader for the '.bin' suffix but it failed "
+                    "torch loader for the '.bin' suffix but it failed "
                     f"({type(e).__name__}: {e}); supported formats are orbax "
-                    f"dirs, TF SavedModel dirs, GraphDef .pb, and torch "
-                    f".safetensors/.ckpt/.pt/.pth/.bin"
+                    "dirs, TF SavedModel dirs, GraphDef .pb, and torch "
+                    ".safetensors/.ckpt/.pt/.pth/.bin"
                 ) from e
             raise
         return model.import_torch_variables(state)

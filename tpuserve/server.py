@@ -46,6 +46,7 @@ from aiohttp import web
 import jax
 
 from tpuserve import models as modelzoo
+from tpuserve.analysis import witness
 from tpuserve.batcher import DeadlineExceeded, ModelBatcher, QueueFull
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
@@ -124,6 +125,13 @@ class ServerState:
             compile_pool.shutdown()
 
     async def start(self) -> None:
+        # Debug-mode race detection (docs/ANALYSIS.md): with
+        # TPUSERVE_LOCK_WITNESS=1 every task created on this loop checks at
+        # each suspension that no witnessed threading lock is held across an
+        # await, and every lock built via utils.locks feeds the global
+        # lock-order graph. The chaos drill runs with this armed in CI.
+        if witness.maybe_install():
+            log.info("lock witness installed (TPUSERVE_LOCK_WITNESS)")
         for name, model in self.models.items():
             rt = self.runtimes[name]
             if hasattr(rt, "enqueue"):  # DeferredPool: bind to the loop
@@ -422,6 +430,9 @@ async def handle_stats(request: web.Request) -> web.Response:
     }
     if state.injector is not None:
         out["robustness"]["faults"] = state.injector.snapshot()
+    if witness.enabled():
+        # Observed lock-order graph + any violations (docs/ANALYSIS.md).
+        out["robustness"]["lock_witness"] = witness.snapshot()
     # Versioned lifecycle state: what version is live per model, what is
     # retained for rollback, and the recent transition history.
     if state.lifecycles:
