@@ -48,14 +48,18 @@ def env_f(name: str, default: float) -> float:
     return float(os.environ.get(name, default))
 
 
-def measure_link_rate_mbps() -> float:
+def measure_link_rate_mbps(chunk_bytes: int = 8 << 20) -> float:
     """Real sustained H2D rate, measured in a virgin subprocess: buffered
     writes + one dependent read = wall-clock truth (shared probe source:
-    tpuserve.bench.probes)."""
+    tpuserve.bench.probes). ``chunk_bytes`` sizes each probe transfer —
+    pass the serving path's per-batch bytes for a ceiling the served
+    numbers can honestly be compared against (see wire-ceiling self-check)."""
     from tpuserve.bench.probes import measure_h2d_mbps
 
     try:
-        r = measure_h2d_mbps("virgin", cwd=os.path.dirname(os.path.abspath(__file__)))
+        r = measure_h2d_mbps("virgin",
+                             cwd=os.path.dirname(os.path.abspath(__file__)),
+                             chunk_bytes=chunk_bytes)
     except Exception as e:  # noqa: BLE001
         r = {"error": str(e)}
     if "mbps" in r:
@@ -65,14 +69,57 @@ def measure_link_rate_mbps() -> float:
     return 0.0
 
 
+def warmup_is_stable(values: list[float], tol: float = 0.10) -> bool:
+    """True once the last two warmup passes agree within ``tol`` (relative
+    to the larger): the signal that executable warmup, arena ramp, and TCP
+    slow-start have washed out and measurement may begin (ISSUE 5 satellite:
+    r05's pass 1 of 3 was consistently ~27% cold despite one warmup pass)."""
+    if len(values) < 2:
+        return False
+    a, b = values[-2], values[-1]
+    hi = max(a, b)
+    return hi > 0 and abs(a - b) / hi <= tol
+
+
+def bench_self_check(line: dict) -> list[str]:
+    """Internal-consistency asserts on the final JSON (printed to stderr,
+    nonzero exit). >110% of the wire ceiling means the ceiling math is
+    wrong, not that the server beat physics (BENCH_r05 reported 162.7%:
+    the link rate was measured at a transfer size the serving path never
+    uses); a visible hit rate on the miss-only passes means the distinct
+    payload pool failed and cache hits are inflating the headline."""
+    failures = []
+    pct = line.get("pct_of_wire_ceiling")
+    if pct is not None and pct > 110:
+        failures.append(
+            f"pct_of_wire_ceiling={pct} > 110: achieved throughput exceeds "
+            "the measured wire ceiling — link_mbps and the per-image wire "
+            "bytes are inconsistent")
+    mhr = line.get("miss_pass_hit_rate")
+    if mhr is not None and mhr > 0.05:
+        failures.append(
+            f"miss_pass_hit_rate={mhr} > 0.05: the miss-only passes hit the "
+            "result cache; the headline is not pure model throughput")
+    return failures
+
+
 def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
-    from tpuserve.config import ModelConfig, ServerConfig
+    from tpuserve.config import CacheConfig, ModelConfig, ServerConfig
     from tpuserve.server import ServerState
 
     cfg = ServerConfig(
         host="127.0.0.1",
         port=int(os.environ.get("BENCH_PORT", 18321)),
         decode_threads=4,
+        # Demand-shaping layer (ISSUE 5): result cache + coalescing armed,
+        # with a capacity deliberately SMALLER than the miss-pass distinct
+        # pool so the measured passes are provably miss-only (LRU
+        # round-robin thrash) while the hit-heavy pass measures the cache.
+        # Adaptive batching is on by default ([adaptive] in config.py).
+        cache=CacheConfig(
+            enabled=bool(int(env_f("BENCH_CACHE", 1))),
+            capacity=int(env_f("BENCH_CACHE_CAPACITY", 16)),
+        ),
         # 1-core dev host: the executor hop only adds latency. Set
         # BENCH_DECODE_INLINE=0 on hosts with real CPU parallelism.
         decode_inline=bool(int(os.environ.get("BENCH_DECODE_INLINE", "1"))),
@@ -113,21 +160,33 @@ def build_state(mode: str, wire_format: str, wire: int, buckets: list[int]):
 
 async def run_load(cfg, payload: bytes, ctype: str, duration: float,
                    warmup: float, concurrency: int, rate: float | None,
-                   client_batch: int = 0) -> dict:
-    """Drive the (already running) server with the out-of-process loadgen."""
+                   client_batch: int = 0, distinct: int = 0,
+                   synth: str = "jpeg", edge: int = 0) -> dict:
+    """Drive the (already running) server with the out-of-process loadgen.
+
+    ``distinct > 1`` switches to a pool of that many distinct synthetic
+    payloads (miss-only cache workload; the loadgen generates them from
+    ``synth``/``edge``); otherwise the single ``payload`` repeats
+    (hit-heavy once the cache is warm)."""
     import tempfile
 
-    with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
-        f.write(payload)
-        payload_path = f.name
+    payload_path = None
     args = [
         sys.executable, "-m", "tpuserve", "bench",
         "--url", f"http://{cfg.host}:{cfg.port}",
         "--model", "resnet50", "--verb", "classify",
         "--duration", str(duration), "--warmup", str(warmup),
         "--concurrency", str(concurrency),
-        "--payload", payload_path, "--content-type", ctype,
+        "--content-type", ctype,
     ]
+    if distinct > 1:
+        args += ["--distinct", str(distinct), "--synthetic", synth,
+                 "--edge", str(edge)]
+    else:
+        with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+            f.write(payload)
+            payload_path = f.name
+        args += ["--payload", payload_path]
     if client_batch > 1:
         args += ["--batch", str(client_batch)]
     if rate:
@@ -142,7 +201,8 @@ async def run_load(cfg, payload: bytes, ctype: str, duration: float,
         out, _ = await proc.communicate()
         return json.loads(out.decode())
     finally:
-        os.unlink(payload_path)
+        if payload_path is not None:
+            os.unlink(payload_path)
 
 
 def print_breakdown(state, header: str) -> None:
@@ -231,8 +291,20 @@ def main() -> int:
     print(f"# payload: {len(payload)}-byte {wire}x{wire} {ctype}"
           + (f" x{client_batch}/POST" if client_batch > 1 else ""), file=sys.stderr)
 
-    async def run() -> tuple[dict, dict | None, list[dict], dict | None]:
-        # ONE server lifecycle for both load phases: app cleanup tears down
+    # Miss-only measured passes (ISSUE 5): a pool of distinct payloads
+    # larger than the server's cache capacity, so the headline can never be
+    # inflated by cache hits even with the cache armed. 0 restores the
+    # single repeated payload (which with BENCH_CACHE=1 measures the cache,
+    # not the model — that is what the separate hit-heavy pass is for).
+    distinct = int(env_f("BENCH_DISTINCT", 64))
+    synth_kind = ("jpeg" if client_batch <= 1
+                  and os.environ.get("BENCH_PAYLOAD", "jpeg") == "jpeg"
+                  else "npy")
+
+    from tpuserve.cache import counter_snapshot, hit_rate
+
+    async def run() -> dict:
+        # ONE server lifecycle for every load phase: app cleanup tears down
         # the model state, so the server must outlive every loadgen run.
         from aiohttp import web
 
@@ -243,48 +315,100 @@ def main() -> int:
         site = web.TCPSite(runner, cfg.host, cfg.port)
         await site.start()
         try:
-            # Discarded warmup pass first (ISSUE 3: r05 closed_spread_per_s
-            # was 178.6): the first window pays executable warmup, arena
-            # ramp, TCP slow-start, and connection establishment, which
-            # dragged the first measured pass — and with it the spread —
-            # down. It prints to stderr and ships in the JSON as
-            # warmup_pass_per_s but never enters the median.
-            warmup_res = None
+            # Discarded warmup passes, extended until stable (ISSUE 5
+            # satellite; r05 pass 1 of 3 was still ~27% cold after ONE
+            # warmup pass): keep warming until two consecutive passes land
+            # within 10%, bounded by BENCH_MAX_WARMUP_PASSES. Every warmup
+            # pass prints to stderr and the list + count ship in the JSON;
+            # none enters the median.
+            warmups: list[dict] = []
             if int(env_f("BENCH_WARMUP_PASS", 1)):
-                warmup_res = await run_load(
-                    cfg, payload, ctype, min(duration, 10.0), warmup,
-                    concurrency, None, client_batch=client_batch)
-                print(f"# closed-loop warmup pass (discarded): {warmup_res}",
-                      file=sys.stderr)
+                max_wu = max(1, int(env_f("BENCH_MAX_WARMUP_PASSES", 4)))
+                for i in range(max_wu):
+                    w = await run_load(
+                        cfg, payload, ctype, min(duration, 10.0),
+                        warmup if i == 0 else 2, concurrency, None,
+                        client_batch=client_batch, distinct=distinct,
+                        synth=synth_kind, edge=wire)
+                    warmups.append(w)
+                    print(f"# warmup pass {i + 1} (discarded): {w}",
+                          file=sys.stderr)
+                    if warmup_is_stable(
+                            [x["throughput_per_s"] for x in warmups]):
+                        break
             # Median-of-3 measured closed-loop passes: the tunnel's rate
             # drifts on minute scales, so a single 20 s window under- or
             # over-draws it. The headline is the MEDIAN pass (max-of-N was
             # upward-biased — VERDICT r3 weak 3 / ADVICE r3); every pass
             # goes to stderr and the full list + spread ship in the JSON.
+            miss_c0 = counter_snapshot(state.metrics, "resnet50")
             passes = []
             for i in range(max(1, int(env_f("BENCH_CLOSED_PASSES", 3)))):
+                # Pass-boundary independence: every pass regenerates the
+                # SAME distinct pool (seeds 0..N-1), so a short pass that
+                # issues fewer requests than the pool would leave entries
+                # the next pass re-hits. Clearing makes miss-only passes
+                # miss-only regardless of pass length; within a pass the
+                # LRU round-robin thrash (pool > capacity) does the job.
+                for c in state.caches.values():
+                    c.clear()
                 res = await run_load(
                     cfg, payload, ctype, duration,
-                    2 if warmup_res is not None or i > 0 else warmup,
-                    concurrency, None, client_batch=client_batch)
+                    2 if warmups or i > 0 else warmup,
+                    concurrency, None, client_batch=client_batch,
+                    distinct=distinct, synth=synth_kind, edge=wire)
                 print(f"# closed-loop pass {i + 1}: {res}", file=sys.stderr)
                 passes.append(res)
+            miss_c1 = counter_snapshot(state.metrics, "resnet50")
+            miss_delta = {k: miss_c1[k] - miss_c0[k] for k in miss_c1}
             by_tp = sorted(passes, key=lambda r: r["throughput_per_s"])
             closed = by_tp[len(by_tp) // 2] if len(by_tp) % 2 else by_tp[len(by_tp) // 2 - 1]
+
+            # Hit-heavy pass: ONE payload repeated, so after the first batch
+            # every request answers from the cache (reported separately —
+            # never the headline).
+            hit_block = None
+            if cfg.cache.enabled and int(env_f("BENCH_HIT_PASS", 1)):
+                c0 = counter_snapshot(state.metrics, "resnet50")
+                hit_res = await run_load(
+                    cfg, payload, ctype, min(duration, 10.0), 2,
+                    concurrency, None, client_batch=client_batch)
+                c1 = counter_snapshot(state.metrics, "resnet50")
+                delta = {k: c1[k] - c0[k] for k in c1}
+                hit_block = {
+                    "throughput_per_s": hit_res["throughput_per_s"],
+                    "p50_ms": hit_res["p50_ms"],
+                    "p99_ms": hit_res["p99_ms"],
+                    "n_err": hit_res["n_err"],
+                    "cache_hit_rate": hit_rate(delta),
+                    # null when the miss pass recorded nothing (degenerate
+                    # short windows) — a ratio against ~0 is meaningless.
+                    "speedup_vs_miss": (round(
+                        hit_res["throughput_per_s"]
+                        / closed["throughput_per_s"], 2)
+                        if closed["throughput_per_s"] > 0 else None),
+                }
+                print(f"# hit-heavy pass: {hit_block}", file=sys.stderr)
+
             open_res = None
             # Open-loop rate is REQUESTS/s; closed throughput counts items.
             rate = env_f("BENCH_OPEN_RATE", 0.0) or round(
                 0.7 * closed["throughput_per_s"] / max(1, client_batch))
             if rate >= 1:
                 open_res = await run_load(
-                    cfg, payload, ctype, min(duration, 15), 3, concurrency, rate,
-                    client_batch=client_batch)
+                    cfg, payload, ctype, min(duration, 15), 3, concurrency,
+                    rate, client_batch=client_batch, distinct=distinct,
+                    synth=synth_kind, edge=wire)
                 print(f"# open-loop @ {rate}/s: {open_res}", file=sys.stderr)
-            return closed, open_res, passes, warmup_res
+            return {"closed": closed, "open": open_res, "passes": passes,
+                    "warmups": warmups, "hit": hit_block,
+                    "miss_hit_rate": hit_rate(miss_delta)}
         finally:
             await runner.cleanup()
 
-    closed, open_res, passes, warmup_res = asyncio.run(run())
+    r = asyncio.run(run())
+    closed, open_res, passes, warmups = (r["closed"], r["open"], r["passes"],
+                                         r["warmups"])
     print_breakdown(state, f"mode={mode}")
 
     n_chips = 1
@@ -295,6 +419,22 @@ def main() -> int:
     except Exception:  # noqa: BLE001
         pass
     per_chip_target = TARGET_V5E8_IMG_S / CHIPS_IN_TARGET * n_chips
+
+    # Wire-ceiling consistency (ISSUE 5 satellite; r05 reported 162.7% of
+    # ceiling): the startup probe measures 8 MiB streaming chunks, but the
+    # serving path transfers one BATCH at a time — on a high-latency link
+    # the two rates differ enough to put "achieved" above "ceiling". Re-probe
+    # at the actual per-batch transfer size and take the better of the two
+    # measurements as the ceiling estimate (also absorbs tunnel rate drift
+    # between the startup probe and the measured passes).
+    link_mbps_matched = None
+    if ceiling == ceiling and int(env_f("BENCH_LINK_REPROBE", 1)):
+        batch_bytes = max(buckets) * img_bytes
+        link_mbps_matched = measure_link_rate_mbps(chunk_bytes=batch_bytes)
+        print(f"# link re-probe at serving batch size ({batch_bytes} B): "
+              f"{link_mbps_matched} MB/s", file=sys.stderr)
+    best_link = max(link_mbps, link_mbps_matched or 0.0)
+    ceiling = best_link * 1e6 / img_bytes if best_link else float("nan")
 
     value = closed["throughput_per_s"]
     line = {
@@ -309,19 +449,35 @@ def main() -> int:
         "mode": mode,
         "wire": f"{wire_format}@{wire}",
         "quantize": quantize,
+        # Miss-only workload shape: >1 means the measured passes cycled a
+        # distinct-payload pool bigger than the cache (headline = model).
+        "distinct_payloads": distinct,
         "closed_passes": [p["throughput_per_s"] for p in passes],
         "closed_spread_per_s": round(
             max(p["throughput_per_s"] for p in passes)
             - min(p["throughput_per_s"] for p in passes), 1),
-        # Discarded warmup pass (never in the median); null when skipped.
-        "warmup_pass_per_s": (warmup_res or {}).get("throughput_per_s"),
+        # Discarded warmup passes (never in the median); extended until two
+        # consecutive agreed within 10% (warmup_is_stable).
+        "warmup_passes_discarded": len(warmups),
+        "warmup_passes_per_s": [w["throughput_per_s"] for w in warmups],
+        "warmup_pass_per_s": (warmups[-1]["throughput_per_s"]
+                              if warmups else None),
         "link_mbps_measured": link_mbps,
+        "link_mbps_matched": link_mbps_matched,
         "wire_ceiling_img_s": round(ceiling, 1) if ceiling == ceiling else None,
         "pct_of_wire_ceiling": round(100 * value / ceiling, 1) if ceiling == ceiling else None,
+        # Cache accounting, always separate from the headline (ISSUE 5):
+        # hit rate observed during the miss-only measured passes (~0 by
+        # construction) and the dedicated hit-heavy pass block.
+        "cache_enabled": cfg.cache.enabled,
+        "miss_pass_hit_rate": r["miss_hit_rate"],
+        "cache_hit_rate": (r["hit"] or {}).get("cache_hit_rate"),
         # Measured fresh THIS run (subprocess probe; null if skipped/failed).
         "chip_compute_img_s": chip.get("img_s"),
         "chip_ms_per_batch": chip.get("ms_per_batch"),
     }
+    if r["hit"]:
+        line["hit_heavy"] = r["hit"]
     if open_res:
         line["open_loop"] = {
             "offered_per_s": open_res.get("offered_rate_per_s"),
@@ -331,6 +487,10 @@ def main() -> int:
         }
     print(f"# total bench wall time {time.time() - t_all:.0f}s", file=sys.stderr)
     print(json.dumps(line))
+    failures = bench_self_check(line)
+    for msg in failures:
+        print(f"# SELF-CHECK FAILED: {msg}", file=sys.stderr)
+    assert not failures, "; ".join(failures)
     return 0
 
 

@@ -1,0 +1,126 @@
+#!/usr/bin/env bash
+# Cache smoke (ISSUE 5): short closed loops through the REAL server on the
+# CPU backend proving the demand-shaping layer end to end:
+#   1. hit-heavy workload (one repeated payload): zero errors, hit rate > 0,
+#      and single-flight coalescing visible in the counters;
+#   2. lifecycle churn: a :reload publish makes the very next identical
+#      request a MISS (version-keyed entries: zero stale-version hits);
+#   3. miss-only workload (distinct pool > capacity): throughput within
+#      noise of an identical cache-OFF server — the cache lookup must not
+#      tax the miss path.
+# Run by CI next to the chaos/reload/pipeline drills; see
+# docs/PERFORMANCE.md "Result cache & coalescing".
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): the new cache and
+# adaptive-scheduler paths run under witnessed locks + per-suspension
+# held-lock checks; a violation raises and fails the smoke.
+export TPUSERVE_LOCK_WITNESS=1
+
+python - <<'EOF'
+import asyncio
+import sys
+
+from aiohttp import web
+import aiohttp
+
+from tpuserve.bench.loadgen import run_load, synthetic_image_npy, synthetic_pool
+from tpuserve.config import CacheConfig, ModelConfig, ServerConfig
+from tpuserve.server import ServerState, make_app
+
+NPY = "application/x-npy"
+
+
+def build(cache_enabled: bool) -> ServerState:
+    cfg = ServerConfig(
+        decode_threads=2,
+        startup_canary=False,
+        cache=CacheConfig(enabled=cache_enabled, capacity=8),
+        models=[ModelConfig(
+            name="toy", family="toy", batch_buckets=[1, 2, 4],
+            deadline_ms=5.0, dtype="float32", num_classes=10,
+            parallelism="single", request_timeout_ms=10_000.0,
+            wire_size=8, max_inflight=2,
+        )],
+    )
+    state = ServerState(cfg)
+    state.build()
+    return state
+
+
+async def serve(state):
+    runner = web.AppRunner(make_app(state), access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+
+async def closed(base, payload, **kw):
+    res = await run_load(f"{base}/v1/models/toy:classify", payload, NPY,
+                         warmup_s=0.5, **kw)
+    assert res.n_err == 0, f"errors during smoke: {res.summary()}"
+    assert res.n_ok > 0, res.summary()
+    return res
+
+
+async def main() -> None:
+    payload = synthetic_image_npy(edge=8)
+    pool = synthetic_pool("npy", 32, edge=8)  # 32 distinct >> capacity 8
+
+    # --- cache-ON server: hit-heavy, then reload churn, then miss-only ----
+    state = build(cache_enabled=True)
+    runner, base = await serve(state)
+    try:
+        hit_res = await closed(base, payload, duration_s=3.0, concurrency=8)
+        cache = state.caches["toy"].stats()
+        assert cache["hits"] > 0, f"hit-heavy run produced no hits: {cache}"
+        rate = cache["hits"] / (cache["hits"] + cache["misses"]
+                                + cache["coalesced"])
+        assert rate > 0.5, f"hit-heavy hit rate suspiciously low: {cache}"
+
+        # Lifecycle churn: publish a new version, then repeat the SAME
+        # payload — a version-keyed cache can only answer it with a miss.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/models/toy:classify", data=payload,
+                              headers={"Content-Type": NPY}) as r:
+                assert r.status == 200
+            pre = state.caches["toy"].stats()
+            async with s.post(f"{base}/admin/models/toy:reload") as r:
+                assert r.status == 200, await r.text()
+            async with s.post(f"{base}/v1/models/toy:classify", data=payload,
+                              headers={"Content-Type": NPY}) as r:
+                assert r.status == 200
+            post = state.caches["toy"].stats()
+        # The repeat after the publish MUST be a miss (no stale hit). The
+        # reload's own canary may add misses too; hits must not move.
+        assert post["misses"] > pre["misses"], (pre, post)
+        assert post["hits"] == pre["hits"], \
+            f"stale-version cache hit after reload: {pre} -> {post}"
+
+        miss_on = await closed(base, pool, duration_s=4.0, concurrency=8)
+        delta = state.caches["toy"].stats()
+    finally:
+        await runner.cleanup()
+
+    # --- cache-OFF server: identical miss-only loop -----------------------
+    state_off = build(cache_enabled=False)
+    runner, base = await serve(state_off)
+    try:
+        miss_off = await closed(base, pool, duration_s=4.0, concurrency=8)
+    finally:
+        await runner.cleanup()
+
+    on, off = miss_on.throughput, miss_off.throughput
+    # Within noise: CI boxes jitter, so the gate is deliberately loose; the
+    # real number ships to stderr for eyeballs.
+    assert on >= 0.5 * off, \
+        f"miss-only throughput collapsed with cache on: {on:.1f} vs {off:.1f}/s"
+    print(f"cache smoke OK: hit-heavy={hit_res.throughput:.1f}/s "
+          f"(hit rate {rate:.2f}), miss-only on/off="
+          f"{on:.1f}/{off:.1f} img/s, cache={delta}")
+
+
+asyncio.run(main())
+EOF

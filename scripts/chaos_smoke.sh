@@ -26,4 +26,7 @@ scripts/reload_drill.sh
 echo "== pipeline smoke (closed loop, zero errors, live occupancy) =="
 scripts/pipeline_smoke.sh
 
+echo "== cache smoke (hit-heavy / reload churn / miss-only parity) =="
+scripts/cache_smoke.sh
+
 echo "chaos smoke OK"

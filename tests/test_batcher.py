@@ -267,3 +267,212 @@ def test_generous_deadline_dispatches_normally(rt_model):
         await b.stop()
 
     run(go())
+
+
+# ---------------------------------------------------------------------------
+# SLO-aware adaptive batching (ISSUE 5): AIMD target + EWMA-bounded flush
+# ---------------------------------------------------------------------------
+
+def make_adaptive_batcher(rt_model, adaptive, **cfg_over):
+    from tpuserve.config import AdaptiveConfig
+
+    model, rt = rt_model
+    cfg_over.setdefault("max_inflight", 2)
+    for k, v in cfg_over.items():
+        setattr(model.cfg, k, v)
+    metrics = Metrics()
+    pool = cf.ThreadPoolExecutor(max_workers=4)
+    acfg = adaptive if isinstance(adaptive, AdaptiveConfig) else AdaptiveConfig(**adaptive)
+    return ModelBatcher(model, rt, metrics, pool, adaptive_cfg=acfg), metrics
+
+
+def test_aimd_grows_on_pressure_shrinks_on_timer():
+    """Unit dynamics: a batch filled to target with work still queued grows
+    the target additively toward the largest bucket; a timer-driven partial
+    flush shrinks it multiplicatively toward min_target — the AIMD sawtooth
+    that makes the scheduler bimodal. A fill with an EMPTY queue is
+    equilibrium: no growth (lone sequential requests at target 1 must not
+    flap between immediate and full-timer flushes)."""
+    from tpuserve.config import AdaptiveConfig, ModelConfig
+    from tpuserve.models import build as build_model
+    from tpuserve.runtime import build_runtime as _brt
+
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                      deadline_ms=30.0, dtype="float32", num_classes=10,
+                      parallelism="single")
+    model = build_model(cfg)
+    b = ModelBatcher(model, _brt(model), Metrics(),
+                     cf.ThreadPoolExecutor(max_workers=2),
+                     adaptive_cfg=AdaptiveConfig(increase=1.0, decrease=0.5))
+    g = None
+    b._aimd_update(g, 2.0, n=2, target_n=2, timer_flush=False, pressure=True)
+    assert b._targets[g] == 3.0
+    b._aimd_update(g, 4.0, n=4, target_n=4, timer_flush=False, pressure=True)
+    assert b._targets[g] == 4.0  # clamped to the largest bucket
+    b._aimd_update(g, 1.0, n=1, target_n=1, timer_flush=False, pressure=False)
+    assert b._targets[g] == 1.0  # equilibrium fill: steady, no flap
+    b._aimd_update(g, 4.0, n=1, target_n=4, timer_flush=True, pressure=False)
+    assert b._targets[g] == 2.0  # starved: multiplicative shrink
+    b._aimd_update(g, 1.2, n=1, target_n=2, timer_flush=True, pressure=False)
+    assert b._targets[g] == 1.0  # floored at min_target
+    # A partial flush NOT driven by the timer (e.g. drain) leaves it alone.
+    b._aimd_update(g, 2.0, n=1, target_n=2, timer_flush=False, pressure=False)
+    assert b._targets[g] == 2.0
+
+
+def test_batch_duration_ewma_tracks_observations():
+    """First observation seeds the EWMA; later ones blend by alpha. The
+    gauge mirrors it so dashboards see the scheduler's duration model."""
+    from tpuserve.config import AdaptiveConfig, ModelConfig
+    from tpuserve.models import build as build_model
+    from tpuserve.runtime import build_runtime as _brt
+
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                      deadline_ms=30.0, dtype="float32", num_classes=10,
+                      parallelism="single")
+    model = build_model(cfg)
+    metrics = Metrics()
+    b = ModelBatcher(model, _brt(model), metrics,
+                     cf.ThreadPoolExecutor(max_workers=2),
+                     adaptive_cfg=AdaptiveConfig(ewma_alpha=0.5))
+    b._observe_batch_duration((4,), 10.0)
+    assert b._ewma_ms[(4,)] == 10.0
+    b._observe_batch_duration((4,), 20.0)
+    assert b._ewma_ms[(4,)] == 15.0  # 10 + 0.5 * (20 - 10)
+    assert metrics.gauge("batch_duration_ewma_ms{model=toy}").value == 15.0
+    # Buckets keep independent duration models.
+    b._observe_batch_duration((1,), 2.0)
+    assert b._ewma_ms[(4,)] == 15.0 and b._ewma_ms[(1,)] == 2.0
+
+
+def test_flush_headroom_from_earliest_deadline():
+    """Clockwork-style bound: the batch must dispatch while ~EWMA + slack
+    still fits before the earliest member deadline; no deadlines => +inf."""
+    import time as _time
+
+    from tpuserve.batcher import _Request
+    from tpuserve.config import AdaptiveConfig, ModelConfig
+    from tpuserve.models import build as build_model
+    from tpuserve.runtime import build_runtime as _brt
+
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                      deadline_ms=30.0, dtype="float32", num_classes=10,
+                      parallelism="single")
+    model = build_model(cfg)
+    b = ModelBatcher(model, _brt(model), Metrics(),
+                     cf.ThreadPoolExecutor(max_workers=2),
+                     adaptive_cfg=AdaptiveConfig(slack_ms=2.0))
+
+    async def go():
+        loop = asyncio.get_running_loop()
+
+        def req(deadline_at):
+            return _Request(item=item(), future=loop.create_future(),
+                            group=None, enqueued_at=_time.perf_counter(),
+                            deadline_at=deadline_at)
+
+        assert b._flush_headroom([req(None)]) == float("inf")
+        now = _time.perf_counter()
+        b._ewma_ms[(2,)] = 8.0  # the 2-item batch rounds up to bucket (2,)
+        got = b._flush_headroom([req(now + 0.100), req(None)])
+        # deadline - (8 ms EWMA + 2 ms slack) = 90 ms from "now".
+        assert got == pytest.approx(now + 0.090, abs=5e-4)
+
+    run(go())
+
+
+def test_adaptive_light_load_flushes_before_max_wait(rt_model):
+    """Bimodal, light side: after timer flushes shrink the target to 1,
+    lone requests flush immediately instead of waiting out deadline_ms —
+    p50 well under the fixed-timer baseline measured in the same test."""
+    import time as _time
+
+    from tpuserve.config import AdaptiveConfig
+
+    async def sequential_p50(b) -> float:
+        lats = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            await asyncio.wait_for(b.submit(item()), timeout=10)
+            lats.append(_time.perf_counter() - t0)
+        return sorted(lats)[len(lats) // 2]
+
+    async def go():
+        # Fixed-timer baseline: every lone request waits out deadline_ms.
+        b, _ = make_adaptive_batcher(rt_model, AdaptiveConfig(enabled=False),
+                                     deadline_ms=120.0)
+        await b.start()
+        fixed_p50 = await sequential_p50(b)
+        await b.stop()
+        assert fixed_p50 >= 0.110, fixed_p50  # sanity: timer really waited
+
+        b, metrics = make_adaptive_batcher(
+            rt_model, AdaptiveConfig(enabled=True, decrease=0.25),
+            deadline_ms=120.0)
+        await b.start()
+        # Warm-down: the first lone flushes are timer-driven and shrink the
+        # target 4 -> 1; discard them like a bench warmup.
+        await sequential_p50(b)
+        assert b._targets[None] == 1.0
+        adaptive_p50 = await sequential_p50(b)
+        await b.stop()
+        assert adaptive_p50 < fixed_p50 / 2, (adaptive_p50, fixed_p50)
+        assert metrics.gauge("adaptive_target_batch{model=toy}").value == 1.0
+
+    run(go())
+
+
+def test_adaptive_saturated_load_fills_buckets(rt_model):
+    """Bimodal, heavy side: with the queue never empty the AIMD target sits
+    at the largest bucket and batches fill — mean batch size >= 0.9x."""
+    from tpuserve.config import AdaptiveConfig
+
+    async def go():
+        b, metrics = make_adaptive_batcher(
+            rt_model, AdaptiveConfig(enabled=True), deadline_ms=50.0,
+            max_queue=64)
+        await b.start()
+        futs = [b.submit(item()) for _ in range(32)]
+        await asyncio.wait_for(asyncio.gather(*futs), timeout=30)
+        await b.stop()
+        batches = metrics.counter("batches_total{model=toy}").value
+        items = metrics.counter("items_total{model=toy}").value
+        assert items == 32
+        mean = items / batches
+        assert mean >= 0.9 * 4, f"saturated mean batch {mean} (in {batches})"
+        # Saturation kept (or grew) the target at the bucket ceiling.
+        assert b._targets[None] == 4.0
+
+    run(go())
+
+
+def test_adaptive_deadline_headroom_preempts_accumulation(rt_model):
+    """A lone request whose deadline leaves less headroom than the observed
+    batch duration + slack flushes NOW, not at the max-wait timer — and
+    beats its deadline instead of discovering it at dispatch."""
+    import time as _time
+
+    from tpuserve.config import AdaptiveConfig
+
+    async def go():
+        b, metrics = make_adaptive_batcher(
+            rt_model,
+            AdaptiveConfig(enabled=True, initial_target=4, slack_ms=2.0),
+            deadline_ms=5_000.0)  # max-wait timer effectively out of play
+        await b.start()
+        # Seed the duration model so headroom math has a real estimate.
+        await asyncio.wait_for(b.submit(item()), timeout=10)
+        b._targets[None] = 4.0  # force re-accumulation despite the flush
+        t0 = _time.perf_counter()
+        fut = b.submit(item(), deadline_at=t0 + 0.150)
+        res = await asyncio.wait_for(fut, timeout=10)
+        took = _time.perf_counter() - t0
+        await b.stop()
+        assert "top_k" in res
+        # Flushed by the headroom bound (~150 ms - EWMA - slack), far below
+        # the 5 s max-wait; generous margin for CI jitter.
+        assert took < 1.0, took
+        assert metrics.counter(
+            "deadline_exceeded_total{model=toy}").value == 0
+
+    run(go())

@@ -200,3 +200,53 @@ def test_warmup_and_describe_cli(tmp_path, capsys):
     assert cli.main(["describe"]) == 0
     desc = json.loads(capsys.readouterr().out)
     assert desc["platform"] == "cpu" and len(desc["devices"]) == 8
+
+
+def test_cache_and_adaptive_blocks(tmp_path):
+    p = tmp_path / "demand.toml"
+    p.write_text(
+        """
+[cache]
+enabled = true
+capacity = 128
+ttl_s = 30.0
+coalesce = false
+
+[adaptive]
+enabled = false
+min_target = 2
+decrease = 0.25
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.cache.enabled is True
+    assert cfg.cache.capacity == 128
+    assert cfg.cache.ttl_s == 30.0
+    assert cfg.cache.coalesce is False
+    assert cfg.cache.max_body_bytes == 1048576  # default preserved
+    assert cfg.adaptive.enabled is False
+    assert cfg.adaptive.min_target == 2
+    assert cfg.adaptive.decrease == 0.25
+    assert cfg.adaptive.increase == 1.0  # default preserved
+
+
+def test_cache_and_adaptive_defaults_and_validation():
+    from tpuserve.config import AdaptiveConfig, CacheConfig
+
+    cfg = ServerConfig(models=[ModelConfig(name="m")])
+    assert cfg.cache.enabled is False  # only deterministic models may opt in
+    assert cfg.adaptive.enabled is True
+    with pytest.raises(ValueError, match="capacity"):
+        CacheConfig(capacity=0)
+    with pytest.raises(ValueError, match=">= 0"):
+        CacheConfig(ttl_s=-1.0)
+    with pytest.raises(ValueError, match="min_target"):
+        AdaptiveConfig(min_target=0)
+    with pytest.raises(ValueError, match="decrease"):
+        AdaptiveConfig(decrease=1.5)
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        AdaptiveConfig(ewma_alpha=0.0)

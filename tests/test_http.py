@@ -396,3 +396,140 @@ def test_canary_shed_without_prior_status(loop):
             await client.close()
 
     loop.run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# Demand-shaping layer over HTTP (ISSUE 5): result cache + coalescing
+# ---------------------------------------------------------------------------
+
+def _cache_state(**cache_over):
+    from tpuserve.config import CacheConfig
+
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2, 4],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single",
+                            request_timeout_ms=10_000.0)],
+        decode_threads=2,
+        cache=CacheConfig(enabled=True, **cache_over),
+    )
+    state = ServerState(cfg)
+    state.build()
+    return state
+
+
+def test_cache_hit_serves_identical_body():
+    """The second identical request answers from the cache — byte-identical
+    body via the pre-serialized fast path, counted as a hit, never a second
+    batch submission."""
+    state = _cache_state()
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            payload = toy_image()
+            hdrs = {"Content-Type": "application/x-npy"}
+            r1 = await client.post("/v1/models/toy:classify", data=payload,
+                                   headers=hdrs)
+            assert r1.status == 200
+            body1 = await r1.read()
+            batches0 = state.metrics.counter(
+                "batches_total{model=toy}").value
+            r2 = await client.post("/v1/models/toy:classify", data=payload,
+                                   headers=hdrs)
+            assert r2.status == 200
+            assert await r2.read() == body1  # pre-serialized hit body
+            c = state.caches["toy"].stats()
+            assert c["hits"] == 1 and c["misses"] == 1
+            # A hit costs zero model work.
+            assert state.metrics.counter(
+                "batches_total{model=toy}").value == batches0
+            # /stats exposes the accounting.
+            stats = await (await client.get("/stats")).json()
+            assert stats["cache"]["toy"]["hits"] == 1
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_client_batch_merges_hits_and_misses_in_order():
+    """A client batch mixing cached, duplicate, and fresh items preserves
+    result order: hits fill their slots from the cache, duplicates coalesce
+    onto one flight, and only genuine misses reach the batcher."""
+    import numpy as np
+
+    state = _cache_state()
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            rng = np.random.default_rng(3)
+            a = rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)
+            bimg = rng.integers(0, 255, (8, 8, 3), dtype=np.uint8)
+            hdrs = {"Content-Type": "application/x-npy"}
+            # Prime the cache with A alone.
+            r = await client.post("/v1/models/toy:classify",
+                                  data=npy_bytes(np.stack([a])), headers=hdrs)
+            assert r.status == 200
+            res_a = (await r.json())["results"][0]
+            # Batch [A, B, B]: A is a pure hit, first B leads a flight, the
+            # duplicate B coalesces onto it.
+            r = await client.post(
+                "/v1/models/toy:classify",
+                data=npy_bytes(np.stack([a, bimg, bimg])), headers=hdrs)
+            assert r.status == 200
+            results = (await r.json())["results"]
+            assert len(results) == 3
+            assert results[0] == res_a  # slot 0 answered from the cache
+            assert results[1] == results[2]  # coalesced duplicates agree
+            c = state.caches["toy"].stats()
+            assert c["hits"] == 1      # A in the mixed batch
+            assert c["misses"] == 2    # A's prime + B's flight
+            assert c["coalesced"] == 1  # the duplicate B
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()
+
+
+def test_cache_disabled_path_untouched():
+    """With [cache] off (the default) no ModelCache is built and repeated
+    identical requests each reach the model."""
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single",
+                            request_timeout_ms=10_000.0)],
+        decode_threads=2,
+    )
+    state = ServerState(cfg)
+    state.build()
+    assert state.caches == {}
+    loop = asyncio.new_event_loop()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            hdrs = {"Content-Type": "application/x-npy"}
+            payload = toy_image()
+            items0 = state.metrics.counter("items_total{model=toy}").value
+            for _ in range(2):
+                r = await client.post("/v1/models/toy:classify",
+                                      data=payload, headers=hdrs)
+                assert r.status == 200
+            # Both identical requests reached the model (no dedup layer).
+            assert state.metrics.counter(
+                "items_total{model=toy}").value == items0 + 2
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+    loop.close()

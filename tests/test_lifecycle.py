@@ -496,3 +496,136 @@ def test_timeout_ms_rejected_when_malformed(loop):
             await client.close()
 
     loop.run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# Result cache under lifecycle churn (ISSUE 5): version-keyed entries mean a
+# publish/rollback can never serve a stale-version hit, and failed batches
+# populate nothing.
+# ---------------------------------------------------------------------------
+
+def _cached_state(model_over=None, **over):
+    from tpuserve.config import CacheConfig
+
+    over.setdefault("cache", CacheConfig(enabled=True))
+    state = ServerState(toy_server_cfg(model_over=model_over, **over))
+    state.build()
+    return state
+
+
+def test_cache_never_serves_stale_version_across_publish_and_rollback(
+        tmp_path, loop):
+    """End to end: a hit before a publish, a forced MISS right after it (the
+    key carries the live version), and post-rollback answers bit-identical
+    to the original weights — at no point does any response mix versions."""
+    ckpt = str(tmp_path / "ckpt")
+    params_a = jax.device_get(toy_params(1))
+    save_orbax(ckpt, params_a)
+    state = _cached_state(model_over=dict(weights=ckpt))
+
+    async def go():
+        client = await _serving_client(state)
+        cache = state.caches["toy"]
+        try:
+            probs_a = await _probs(client)
+            assert await _probs(client) == probs_a  # cache answers v1
+            pre = cache.stats()
+            assert pre["hits"] >= 1
+
+            # Publish genuinely different weights.
+            shutil.rmtree(ckpt)
+            save_orbax(ckpt, jax.tree_util.tree_map(lambda x: x + 0.25,
+                                                    params_a))
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 200, await r.text()
+            probs_b = await _probs(client)
+            post = cache.stats()
+            # The identical payload after the publish was a MISS under the
+            # new version key — zero stale-version hits, new weights answer.
+            assert post["hits"] == pre["hits"], (pre, post)
+            assert post["misses"] > pre["misses"]
+            assert probs_b != probs_a
+
+            # Rollback restores v1 bit-identically; v1-keyed entries are
+            # live again and correct BY CONSTRUCTION (same weights).
+            r = await client.post("/admin/models/toy:rollback")
+            assert r.status == 200, await r.text()
+            assert await _probs(client) == probs_a
+            assert await _probs(client) != probs_b
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_mid_flight_publish_drops_result_coalesced_waiters_still_answered(
+        loop):
+    """A flight admitted under v1 that completes after a publish to v2 must
+    fan its result to every coalesced waiter (same answer an uncached
+    request spanning the publish would get) but never populate the cache —
+    no future lookup under either version may observe it."""
+    state = _cached_state()
+
+    async def go():
+        client = await _serving_client(state)
+        cache = state.caches["toy"]
+        try:
+            key = cache.key_for(np.zeros((8, 8, 3), np.uint8))
+            assert key.startswith("1:")
+            base = asyncio.get_running_loop().create_future()
+            waiters = [cache.submit_through(key, lambda: base)
+                       for _ in range(3)]
+            # Publish lands while the flight is in the air.
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 200, await r.text()
+            assert state.runtimes["toy"].version == 2
+            base.set_result({"top_k": [{"class": 0, "prob": 1.0}]})
+            res = await asyncio.gather(*waiters)
+            assert all(r_ == res[0] for r_ in res)  # every waiter answered
+            stats = cache.stats()
+            assert stats["stale_drops"] == 1
+            assert cache.get(key) is None  # not under the old key
+            assert cache.get(cache.key_for(
+                np.zeros((8, 8, 3), np.uint8))) is None  # nor the new one
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
+def test_poison_split_failure_populates_nothing(loop):
+    """PR-1 containment meets the cache: a batch that fails through retry +
+    poison-split isolation must leave ZERO cache entries — the next
+    identical request is a fresh miss that reaches the model."""
+    state = _cached_state(model_over=dict(batch_retry=True,
+                                          retry_split=True))
+
+    async def go():
+        client = await _serving_client(state)
+        cache = state.caches["toy"]
+        try:
+            entries0 = cache.stats()["entries"]
+            state.batchers["toy"].injector = FaultInjector.single(
+                "batch_error")
+            r = await client.post("/v1/models/toy:predict",
+                                  data=npy_image(42), headers=NPY)
+            assert r.status == 500
+            failed = cache.stats()
+            assert failed["entries"] == entries0  # failure cached NOTHING
+            assert failed["misses"] >= 1
+
+            state.batchers["toy"].injector = None
+            r = await client.post("/v1/models/toy:predict",
+                                  data=npy_image(42), headers=NPY)
+            assert r.status == 200, await r.text()
+            ok = cache.stats()
+            # The retry was a genuine model execution (miss), not a hit on
+            # the failed flight's ghost.
+            assert ok["misses"] == failed["misses"] + 1
+            assert ok["hits"] == failed["hits"]
+            assert ok["entries"] == entries0 + 1
+        finally:
+            state.batchers["toy"].injector = None
+            await client.close()
+
+    loop.run_until_complete(go())

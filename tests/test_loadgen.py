@@ -122,3 +122,48 @@ def test_synthetic_batch_payload_shape():
 
     arr = np.load(io.BytesIO(synthetic_image_npy_batch(16, 4)), allow_pickle=False)
     assert arr.shape == (4, 16, 16, 3) and arr.dtype == np.uint8
+
+
+def test_synthetic_pool_distinct_bodies():
+    """Miss-only workload construction: every pooled payload is distinct
+    (distinct pixels => distinct cache keys) and decodes to the wire shape."""
+    import io
+
+    import numpy as np
+
+    from tpuserve.bench.loadgen import synthetic_pool
+
+    pool = synthetic_pool("npy", 8, edge=8)
+    assert len(pool) == 8
+    assert len({p for p in pool}) == 8  # all byte-distinct
+    arr = np.load(io.BytesIO(pool[0]))
+    assert arr.shape == (8, 8, 3) and arr.dtype == np.uint8
+    batched = synthetic_pool("npy", 3, edge=8, batch=4)
+    assert np.load(io.BytesIO(batched[0])).shape == (4, 8, 8, 3)
+
+
+def test_closed_loop_cycles_distinct_pool(loop):
+    """A list payload round-robins across workers and is reported in the
+    summary, so a bench JSON always shows the workload shape."""
+    seen = []
+
+    async def handler(request: web.Request) -> web.Response:
+        seen.append(await request.read())
+        return web.json_response({"ok": True})
+
+    async def go():
+        app = web.Application()
+        app.router.add_post("/v1/x", handler)
+        server = TestServer(app)
+        await server.start_server()
+        pool = [f"payload-{i}".encode() for i in range(4)]
+        res = await run_load(f"http://127.0.0.1:{server.port}/v1/x", pool,
+                             "application/octet-stream", duration_s=0.4,
+                             concurrency=4, warmup_s=0.0)
+        await server.close()
+        assert res.n_ok > 0
+        assert res.summary()["distinct_payloads"] == 4
+        # All four bodies actually hit the wire.
+        assert {s.decode() for s in seen} == {f"payload-{i}" for i in range(4)}
+
+    loop.run_until_complete(go())

@@ -25,6 +25,20 @@ many batches occupy the device section [h2d..fetch] at once. Admission into
 the pipeline (depth x replicas + assemble_ahead batches) replaces the old
 single semaphore acquired before assembly even started.
 
+Flush scheduling is **SLO-aware and adaptive** (ISSUE 5; docs/PERFORMANCE.md
+"Adaptive batching"): instead of always accumulating toward the largest
+bucket under a fixed max-wait timer, each group keeps an AIMD-adjusted
+*target batch size* (Clipper, PAPERS.md P1) — a batch that fills to target
+with work still queued grows it additively, a timer-driven partial flush
+shrinks it multiplicatively — so light load converges to target 1 (flush immediately,
+no deadline_ms wait) while sustained load converges to the bucket
+(throughput). A per-bucket EWMA of observed batch duration (Clockwork, P3:
+inference duration is predictable) bounds the wait further: a batch whose
+earliest member deadline leaves less than EWMA + slack of headroom flushes
+NOW rather than discovering the deadline at dispatch. ``deadline_ms``
+remains the max-wait backstop, and ``[adaptive] enabled = false`` restores
+the fixed-timer behavior exactly.
+
 Failure containment (SURVEY.md §5, docs/ROBUSTNESS.md): a failed dispatch
 first re-assembles and re-runs the batch once (``batch_retry``); if the
 retry also fails the batch recursively bisects (``retry_split``) so a single
@@ -44,14 +58,15 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures as cf
 import logging
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Any, Hashable
 
-from tpuserve.config import PipelineConfig
+from tpuserve.config import AdaptiveConfig, PipelineConfig
 from tpuserve.hostpipe import AssemblyArena, SlotPool, StageExecutors
 from tpuserve.models.base import ServingModel
-from tpuserve.obs import Metrics
+from tpuserve.obs import PHASES, Metrics
 from tpuserve.runtime import ModelRuntime
 
 log = logging.getLogger("tpuserve.batcher")
@@ -91,6 +106,7 @@ class ModelBatcher:
         injector: "Any | None" = None,
         stages: "StageExecutors | None" = None,
         pipeline_cfg: "PipelineConfig | None" = None,
+        adaptive_cfg: "AdaptiveConfig | None" = None,
     ) -> None:
         self.model = model
         self.runtime = runtime
@@ -104,6 +120,34 @@ class ModelBatcher:
         self.pool = pool
         self.cfg = model.cfg
         self.pipeline_cfg = pipeline_cfg or PipelineConfig()
+        self.adaptive_cfg = adaptive_cfg or AdaptiveConfig()
+        # Adaptive scheduler state (event loop only): AIMD target batch size
+        # per group, batch-duration EWMA per bucket key.
+        self._targets: dict[Hashable, float] = {}
+        self._ewma_ms: dict[tuple, float] = {}
+        # Hot-path metric handles, prebound once (ISSUE 5 satellite: the
+        # per-request/per-flush f-string format + registry lookup was pure
+        # overhead on every submit).
+        name = model.cfg.name
+        self._g_queue_depth = metrics.gauge(f"queue_depth{{model={name}}}")
+        self._g_fill = metrics.gauge(f"batch_fill_ratio{{model={name}}}")
+        self._g_inflight = metrics.gauge(f"pipeline_inflight{{model={name}}}")
+        self._g_target = metrics.gauge(f"adaptive_target_batch{{model={name}}}")
+        self._g_ewma = metrics.gauge(f"batch_duration_ewma_ms{{model={name}}}")
+        self._c_shed = metrics.counter(f"shed_total{{model={name}}}")
+        self._c_deadline = metrics.counter(
+            f"deadline_exceeded_total{{model={name}}}")
+        self._c_batches = metrics.counter(f"batches_total{{model={name}}}")
+        self._c_items = metrics.counter(f"items_total{{model={name}}}")
+        self._c_batch_errors = metrics.counter(
+            f"batch_errors_total{{model={name}}}")
+        self._c_retries = metrics.counter(f"batch_retries_total{{model={name}}}")
+        self._c_retry_failures = metrics.counter(
+            f"batch_retry_failures_total{{model={name}}}")
+        self._c_poison = metrics.counter(f"poison_items_total{{model={name}}}")
+        self._h_phase = {
+            p: metrics.histogram(f"latency_ms{{model={name},phase={p}}}")
+            for p in PHASES}
         # Stage executors are normally server-owned and shared across models
         # (stage-granularity scheduling); a batcher built without one (tests,
         # embedding) creates and later shuts down its own.
@@ -209,7 +253,7 @@ class ModelBatcher:
         if not self._running or self._inflight is None:
             raise RuntimeError(f"batcher for {self.model.name} not started")
         if self._pending >= self.cfg.max_queue:
-            self.metrics.counter(f"shed_total{{model={self.model.name}}}").inc()
+            self._c_shed.inc()
             raise QueueFull(self.model.name)
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -222,7 +266,7 @@ class ModelBatcher:
         q.put_nowait(req)
         self._pending += 1
         self._idle_event.clear()
-        self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
+        self._g_queue_depth.set(self._pending)
         return fut
 
     def revive_group_loops(self) -> int:
@@ -308,19 +352,60 @@ class ModelBatcher:
                 continue
             live.append(r)
         if n_expired:
-            self.metrics.counter(
-                f"deadline_exceeded_total{{model={self.model.name}}}"
-            ).inc(n_expired)
+            self._c_deadline.inc(n_expired)
         if adjust_pending and len(live) != len(reqs):
-            self.metrics.gauge(
-                f"queue_depth{{model={self.model.name}}}").set(self._pending)
+            self._g_queue_depth.set(self._pending)
             self._maybe_idle()
         return live
+
+    # -- adaptive flush scheduling (event loop) ------------------------------
+    def _flush_headroom(self, batch: list[_Request]) -> float:
+        """Earliest-deadline flush bound (perf_counter clock): the batch must
+        dispatch while ~EWMA(batch duration) + slack still fits before the
+        earliest per-request deadline (Clockwork P3 — duration is
+        predictable, so schedule against it instead of discovering the
+        deadline at dispatch). +inf when no member carries a deadline."""
+        earliest = min((r.deadline_at for r in batch
+                        if r.deadline_at is not None), default=None)
+        if earliest is None:
+            return float("inf")
+        bucket = self.model.bucket_for(len(batch), group=batch[0].group)
+        est_ms = self._ewma_ms.get(bucket, 0.0)
+        return earliest - (est_ms + self.adaptive_cfg.slack_ms) / 1e3
+
+    def _aimd_update(self, group: Hashable, tgt: float, n: int,
+                     target_n: int, timer_flush: bool,
+                     pressure: bool) -> None:
+        """AIMD (Clipper P1): a batch that filled to target WITH more work
+        still queued (``pressure``) grows the target additively; a
+        timer-driven partial flush shrinks it multiplicatively toward
+        min_target. A batch that fills with an empty queue is equilibrium —
+        growing on it would make lone sequential requests at target 1 flap
+        between immediate and full-timer flushes. Light load therefore
+        converges to immediate single-request flushes, saturation to full
+        buckets."""
+        acfg = self.adaptive_cfg
+        if n >= target_n and pressure:
+            tgt = min(float(max(self.cfg.batch_buckets)), tgt + acfg.increase)
+        elif timer_flush and n < target_n:
+            tgt = max(float(acfg.min_target), tgt * acfg.decrease)
+        self._targets[group] = tgt
+        self._g_target.set(tgt)
+
+    def _observe_batch_duration(self, bucket: tuple, dur_ms: float) -> None:
+        prev = self._ewma_ms.get(bucket)
+        alpha = self.adaptive_cfg.ewma_alpha
+        ewma = dur_ms if prev is None else prev + alpha * (dur_ms - prev)
+        self._ewma_ms[bucket] = ewma
+        self._g_ewma.set(ewma)
 
     # -- accumulation (event loop) ------------------------------------------
     async def _group_loop(self, group: Hashable, q: asyncio.Queue) -> None:
         max_bucket = max(self.cfg.batch_buckets)
         deadline_s = self.cfg.deadline_ms / 1e3
+        acfg = self.adaptive_cfg
+        adaptive = acfg.enabled
+        init_target = float(acfg.initial_target or max_bucket)
         while True:
             if self.injector is not None:
                 # Chaos: an escaped exception kills this task, exactly the
@@ -328,16 +413,31 @@ class ModelBatcher:
                 self.injector.check("kill_group_loop", self.model.name)
             req = await q.get()
             batch = [req]
+            tgt = self._targets.get(group, init_target)
+            target_n = (min(max_bucket, max(acfg.min_target, math.ceil(tgt)))
+                        if adaptive else max_bucket)
+            timer_flush = False
             try:
+                # Max-wait backstop: adaptive mode additionally bounds the
+                # wait by the deadline headroom, and stops accumulating at
+                # the AIMD target instead of the largest bucket.
                 flush_at = req.enqueued_at + deadline_s
-                while len(batch) < max_bucket:
-                    timeout = flush_at - time.perf_counter()
+                while len(batch) < target_n:
+                    limit = flush_at
+                    if adaptive:
+                        limit = min(limit, self._flush_headroom(batch))
+                    timeout = limit - time.perf_counter()
                     if timeout <= 0:
+                        timer_flush = True
                         break
                     try:
                         batch.append(await asyncio.wait_for(q.get(), timeout))
                     except asyncio.TimeoutError:
+                        timer_flush = True
                         break
+                if adaptive:
+                    self._aimd_update(group, tgt, len(batch), target_n,
+                                      timer_flush, pressure=not q.empty())
                 # Backpressure: admission bounds batches inside the pipeline
                 # (depth x replicas in the device section + assemble_ahead
                 # ramping through assembly); the group task itself waits
@@ -382,7 +482,7 @@ class ModelBatcher:
             while len(batch) < max_bucket and not q.empty():
                 batch.append(q.get_nowait())
             self._pending -= len(batch)
-            self.metrics.gauge(f"queue_depth{{model={self.model.name}}}").set(self._pending)
+            self._g_queue_depth.set(self._pending)
             live = [r for r in batch if not r.future.cancelled()]
             # Last deadline check at flush: requests drained from the queue
             # above may have expired too. Their pending count was already
@@ -394,7 +494,7 @@ class ModelBatcher:
                 continue
             now = time.perf_counter()
             for r in live:
-                self.metrics.observe_phase(self.model.name, "queue", (now - r.enqueued_at) * 1e3)
+                self._h_phase["queue"].observe((now - r.enqueued_at) * 1e3)
             task = asyncio.get_running_loop().create_task(self._dispatch(live, group))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._dispatch_tasks.discard)
@@ -409,14 +509,13 @@ class ModelBatcher:
         released = [False]  # deferred mode releases admission mid-flight
         self._inflight_now += 1
         self._inflight_peak = max(self._inflight_peak, self._inflight_now)
-        occupancy = self.metrics.gauge(f"pipeline_inflight{{model={name}}}")
-        occupancy.set(self._inflight_now)
+        self._g_inflight.set(self._inflight_now)
         try:
             try:
                 await self._execute(reqs, group, released)
             except Exception as e:
                 log.exception("batch dispatch failed for %s", name)
-                self.metrics.counter(f"batch_errors_total{{model={name}}}").inc()
+                self._c_batch_errors.inc()
                 if self.breaker is not None:
                     self.breaker.record_failure()
                 live = [r for r in reqs if not r.future.done()]
@@ -435,7 +534,7 @@ class ModelBatcher:
                         r.future.set_exception(e)
         finally:
             self._inflight_now -= 1
-            occupancy.set(self._inflight_now)
+            self._g_inflight.set(self._inflight_now)
             if not released[0]:
                 self._inflight.release()
 
@@ -475,8 +574,8 @@ class ModelBatcher:
         name = self.model.name
         bucket = self.model.bucket_for(len(reqs), group=group)
         fill = len(reqs) / bucket[0]
-        self.metrics.gauge(f"batch_fill_ratio{{model={name}}}").set(fill)
-        self.metrics.counter(f"batches_total{{model={name}}}").inc()
+        self._g_fill.set(fill)
+        self._c_batches.inc()
 
         wall0 = time.time()
         t0 = time.perf_counter()
@@ -493,7 +592,7 @@ class ModelBatcher:
                 host_batch = await self.stages.run(
                     name, "assemble", self.model.assemble, items, bucket)
             t1 = time.perf_counter()
-            self.metrics.observe_phase(name, "preproc", (t1 - t0) * 1e3)
+            self._h_phase["preproc"].observe((t1 - t0) * 1e3)
 
             if self.deferred:
                 # Deferred mode: enqueue is cheap (shm write + slot wait =
@@ -508,13 +607,13 @@ class ModelBatcher:
                     self.injector.check("batch_error", name)
                 out_fut = await self.runtime.enqueue(bucket, host_batch)
                 t2 = time.perf_counter()
-                self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+                self._h_phase["h2d"].observe((t2 - t1) * 1e3)
                 if not released[0]:
                     self._inflight.release()
                     released[0] = True
                 np_out = await out_fut
                 t3 = time.perf_counter()
-                self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+                self._h_phase["compute"].observe((t3 - t2) * 1e3)
             else:
                 # Device section: a staging slot bounds batches inside
                 # [h2d..fetch] to depth-k per replica; the wait is
@@ -534,7 +633,7 @@ class ModelBatcher:
                         name, "h2d", self.runtime.run, bucket, host_batch,
                         replica)
                     t2 = time.perf_counter()
-                    self.metrics.observe_phase(name, "h2d", (t2 - t1) * 1e3)
+                    self._h_phase["h2d"].observe((t2 - t1) * 1e3)
 
                     # fetch stage: "compute" = dispatch-to-ready wall time.
                     # With per-stage executors this is the device's own
@@ -544,7 +643,7 @@ class ModelBatcher:
                     np_out = await self.stages.run(
                         name, "fetch", self.runtime.fetch, outputs)
                     t3 = time.perf_counter()
-                    self.metrics.observe_phase(name, "compute", (t3 - t2) * 1e3)
+                    self._h_phase["compute"].observe((t3 - t2) * 1e3)
                 finally:
                     self._staging[replica].release(slot)
         finally:
@@ -557,8 +656,11 @@ class ModelBatcher:
         results = await self.stages.run(
             name, "postproc", self.model.host_postprocess, np_out, len(reqs))
         t4 = time.perf_counter()
-        self.metrics.observe_phase(name, "postproc", (t4 - t3) * 1e3)
-        self.metrics.counter(f"items_total{{model={name}}}").inc(len(reqs))
+        self._h_phase["postproc"].observe((t4 - t3) * 1e3)
+        self._c_items.inc(len(reqs))
+        # Feed the adaptive scheduler's per-bucket duration model (tracked
+        # even with adaptive off: the gauge is useful on its own).
+        self._observe_batch_duration(bucket, (t4 - t0) * 1e3)
         # Span start/duration from the same wall-clock capture: mixing a
         # perf_counter delta into a fresh time.time() read skewed span
         # starts by the time spent between the two calls.
@@ -583,7 +685,7 @@ class ModelBatcher:
         case a lane re-runs O(log batch) times; every path ends with all
         futures resolved."""
         name = self.model.name
-        self.metrics.counter(f"batch_retries_total{{model={name}}}").inc()
+        self._c_retries.inc()
 
         async def run_split(rs: list[_Request]) -> None:
             live = [r for r in rs if not r.future.done()]
@@ -592,12 +694,10 @@ class ModelBatcher:
             try:
                 await self._execute(live, group, released)
             except Exception as e:
-                self.metrics.counter(
-                    f"batch_retry_failures_total{{model={name}}}").inc()
+                self._c_retry_failures.inc()
                 if len(live) == 1 or not self.cfg.retry_split:
                     if len(live) == 1 and self.cfg.retry_split:
-                        self.metrics.counter(
-                            f"poison_items_total{{model={name}}}").inc()
+                        self._c_poison.inc()
                     for r in live:
                         if not r.future.done():
                             r.future.set_exception(e)
@@ -617,6 +717,13 @@ class ModelBatcher:
             "admission": self._admission_cap,
             "inflight": self._inflight_now,
             "inflight_peak": self._inflight_peak,
+            "adaptive": {
+                "enabled": self.adaptive_cfg.enabled,
+                "targets": {repr(g): round(t, 2)
+                            for g, t in self._targets.items()},
+                "batch_ewma_ms": {repr(b): round(v, 2)
+                                  for b, v in self._ewma_ms.items()},
+            },
         }
         if not self.deferred:
             out["depth"] = self.depth

@@ -14,6 +14,13 @@ Window accounting, both modes: a request is recorded only if it *completes*
 inside the measurement window ``[warmup, warmup + duration)``; throughput
 divides by the actual window length. In-flight stragglers at window end are
 counted separately (``n_late``) and never inflate throughput.
+
+Workload shaping for the result cache (ISSUE 5): ``payload`` may be a LIST
+of bodies, cycled round-robin across issues. A pool of N distinct payloads
+larger than the server's cache capacity is a **miss-only** workload (LRU
+round-robin thrash: every lookup misses), while the single-payload default
+is **hit-heavy** once the cache is warm — ``synthetic_pool`` builds the
+distinct bodies, and the CLI exposes it as ``--distinct N``.
 """
 
 from __future__ import annotations
@@ -41,6 +48,8 @@ class LoadResult:
     # {"results": [...]} shape). Throughput counts ITEMS; latencies are still
     # whole-request (the time to answer all items in the POST).
     items_per_request: int = 1
+    # Size of the distinct-payload pool cycled by the run (0 = one payload).
+    distinct_payloads: int = 0
     latencies_ms: list[float] = field(default_factory=list)
 
     @property
@@ -63,6 +72,8 @@ class LoadResult:
         }
         if self.items_per_request != 1:
             out["items_per_request"] = self.items_per_request
+        if self.distinct_payloads:
+            out["distinct_payloads"] = self.distinct_payloads
         if self.mode == "open":
             out["offered_rate_per_s"] = round(self.offered_rate, 1)
         return out
@@ -83,6 +94,19 @@ def synthetic_image_npy_batch(edge: int = 256, n: int = 8, seed: int = 0) -> byt
     buf = io.BytesIO()
     np.save(buf, arr)
     return buf.getvalue()
+
+
+def synthetic_pool(kind: str, n: int, edge: int = 256,
+                   batch: int = 0) -> list[bytes]:
+    """``n`` distinct synthetic payloads (seeds 0..n-1) for miss-only
+    workloads: every body decodes to different pixels, so every request is
+    a new cache key. ``kind`` is "jpeg" or "npy"; ``batch > 1`` builds
+    (batch, edge, edge, 3) npy client batches instead."""
+    if batch > 1:
+        return [synthetic_image_npy_batch(edge, batch, seed=i)
+                for i in range(n)]
+    gen = synthetic_image_jpeg if kind == "jpeg" else synthetic_image_npy
+    return [gen(edge, seed=i) for i in range(n)]
 
 
 def synthetic_image_jpeg(edge: int = 256, seed: int = 0, quality: int = 85) -> bytes:
@@ -120,27 +144,38 @@ def _record(result: LoadResult, ok: bool, t0: float, t1: float,
 
 async def run_load(
     url: str,
-    payload: bytes,
+    payload: "bytes | list[bytes]",
     content_type: str,
     duration_s: float = 10.0,
     concurrency: int = 64,
     warmup_s: float = 2.0,
     items_per_request: int = 1,
 ) -> LoadResult:
-    """Closed loop: `concurrency` workers, one request in flight each."""
+    """Closed loop: `concurrency` workers, one request in flight each.
+    A list ``payload`` is a distinct-body pool cycled round-robin across
+    the workers (miss-only cache workloads)."""
     import aiohttp
 
-    result = LoadResult(mode="closed", items_per_request=items_per_request)
+    pool = payload if isinstance(payload, (list, tuple)) else None
+    result = LoadResult(mode="closed", items_per_request=items_per_request,
+                        distinct_payloads=len(pool) if pool else 0)
     headers = {"Content-Type": content_type}
     now = time.perf_counter()
     record_from = now + warmup_s
     stop_at = now + warmup_s + duration_s
+    cursor = 0  # shared round-robin index over the distinct-payload pool
 
     async def worker(session: aiohttp.ClientSession) -> None:
+        nonlocal cursor
         while time.perf_counter() < stop_at:
+            if pool is not None:
+                data = pool[cursor % len(pool)]
+                cursor += 1
+            else:
+                data = payload
             t0 = time.perf_counter()
             try:
-                async with session.post(url, data=payload, headers=headers) as resp:
+                async with session.post(url, data=data, headers=headers) as resp:
                     await resp.read()
                     ok = resp.status == 200
             except Exception:
@@ -157,7 +192,7 @@ async def run_load(
 
 async def run_load_open(
     url: str,
-    payload: bytes,
+    payload: "bytes | list[bytes]",
     content_type: str,
     rate_per_s: float,
     duration_s: float = 10.0,
@@ -169,26 +204,31 @@ async def run_load_open(
     completions. If the server can't keep up, in-flight grows toward
     ``max_inflight``; beyond it issues are dropped and counted as errors
     (the alternative — silently pausing the clock — would turn the mode
-    closed-loop and overstate the server)."""
+    closed-loop and overstate the server). A list ``payload`` cycles a
+    distinct-body pool as in run_load."""
     import aiohttp
 
     if rate_per_s <= 0:
         raise ValueError(f"rate_per_s must be positive, got {rate_per_s}")
+    pool = payload if isinstance(payload, (list, tuple)) else None
     result = LoadResult(mode="open", offered_rate=rate_per_s,
-                        items_per_request=items_per_request)
+                        items_per_request=items_per_request,
+                        distinct_payloads=len(pool) if pool else 0)
     headers = {"Content-Type": content_type}
     interval = 1.0 / rate_per_s
     now = time.perf_counter()
     record_from = now + warmup_s
     stop_at = now + warmup_s + duration_s
     inflight = 0
+    issued = 0
     tasks: set[asyncio.Task] = set()
 
-    async def one(session: aiohttp.ClientSession) -> None:
+    async def one(session: aiohttp.ClientSession, seq: int) -> None:
         nonlocal inflight
+        data = pool[seq % len(pool)] if pool is not None else payload
         t0 = time.perf_counter()
         try:
-            async with session.post(url, data=payload, headers=headers) as resp:
+            async with session.post(url, data=data, headers=headers) as resp:
                 await resp.read()
                 ok = resp.status == 200
         except Exception:
@@ -209,7 +249,8 @@ async def run_load_open(
                     result.n_err += 1  # shed at the client: server saturated
             else:
                 inflight += 1
-                t = asyncio.ensure_future(one(session))
+                t = asyncio.ensure_future(one(session, issued))
+                issued += 1
                 tasks.add(t)
                 t.add_done_callback(tasks.discard)
             next_issue += interval
@@ -221,7 +262,14 @@ async def run_load_open(
 
 def run_loadgen_cli(args) -> int:
     batch = int(getattr(args, "batch", 0) or 0)
-    if args.payload:
+    distinct = int(getattr(args, "distinct", 0) or 0)
+    if distinct > 1:
+        # Miss-only workload: a pool of distinct synthetic bodies, cycled
+        # round-robin (a pool larger than the server's cache capacity makes
+        # every lookup an LRU miss).
+        payload = synthetic_pool(getattr(args, "synthetic", "npy"), distinct,
+                                 int(getattr(args, "edge", 256)), batch)
+    elif args.payload:
         with open(args.payload, "rb") as f:
             payload = f.read()
     elif batch > 1:

@@ -17,8 +17,8 @@ import textwrap
 
 H2D_PROBE_SRC = textwrap.dedent("""
     import time, json, numpy as np, jax, jax.numpy as jnp
-    mode = %r
-    CHUNK = 8 << 20  # every transfer is this shape: compiles warm once
+    mode = %(mode)r
+    CHUNK = %(chunk)d  # every transfer is this shape: compiles warm once
     chunk = np.random.default_rng(0).integers(0, 255, (CHUNK,), np.uint8)
 
     # Untimed warm-up in EVERY mode: PJRT client init, first-transfer setup,
@@ -52,14 +52,24 @@ H2D_PROBE_SRC = textwrap.dedent("""
 
 
 def measure_h2d_mbps(mode: str = "virgin", timeout: float = 600.0,
-                     cwd: str | None = None) -> dict:
+                     cwd: str | None = None,
+                     chunk_bytes: int = 8 << 20) -> dict:
     """Run the H2D probe in a fresh subprocess; mode 'virgin' | 'after_d2h'.
+
+    ``chunk_bytes`` sizes every probe transfer. The default (8 MiB) measures
+    the link's best-case streaming rate; pass the serving path's actual
+    per-batch transfer size (batch x wire bytes/img) to measure the rate the
+    server can really draw — per-transfer latency makes the two differ on
+    high-latency links, which is exactly the inconsistency that produced a
+    162-percent-of-ceiling bench reading (ISSUE 5 satellite: the ceiling must be
+    computed from a rate measured at the serving transfer size).
 
     Returns {"mbps": float, "probe_bytes": int} or {"error": str}.
     """
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", H2D_PROBE_SRC % mode],
+            [sys.executable, "-c",
+             H2D_PROBE_SRC % {"mode": mode, "chunk": max(1, int(chunk_bytes))}],
             capture_output=True, text=True, timeout=timeout, cwd=cwd,
         )
     except subprocess.TimeoutExpired:

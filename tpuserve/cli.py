@@ -61,6 +61,15 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--batch", type=int, default=0,
                          help="client-side batch: POST (N,H,W,3) npy bodies; "
                               "throughput counts items")
+    p_bench.add_argument("--distinct", type=int, default=0,
+                         help="cycle N distinct synthetic payloads — a "
+                              "miss-only workload for the result cache when "
+                              "N exceeds its capacity; 0/1 repeats one "
+                              "payload (hit-heavy once the cache is warm)")
+    p_bench.add_argument("--synthetic", choices=["npy", "jpeg"], default="npy",
+                         help="synthetic payload kind for --distinct pools")
+    p_bench.add_argument("--edge", type=int, default=256,
+                         help="synthetic payload image edge for --distinct")
 
     p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
     p_imp.add_argument("--saved-model", required=True)

@@ -153,6 +153,81 @@ class PipelineConfig:
 
 
 @dataclass
+class CacheConfig:
+    """Content-addressed result cache + single-flight coalescing (``[cache]``
+    TOML; tpuserve.cache, docs/PERFORMANCE.md "Result cache & coalescing").
+
+    Key = digest(model, live version, preprocessed item); value = the
+    postprocessed result. The live model version is part of every key, so a
+    lifecycle publish/rollback (tpuserve.lifecycle) atomically invalidates
+    all previous entries without a sweep. Hits and coalesced waiters are
+    counted separately from misses so cache traffic can never masquerade as
+    model throughput in a bench."""
+
+    enabled: bool = False
+    # Max cached results per model (LRU beyond it).
+    capacity: int = 4096
+    # Entry time-to-live in seconds; 0 disables expiry (version churn is the
+    # primary invalidation — TTL exists for non-deterministic models).
+    ttl_s: float = 0.0
+    # Single-flight: N concurrent identical misses occupy ONE batch slot,
+    # the result fanning out to every waiter (Clipper P1's prediction-cache
+    # trick, which also de-thunders retry storms).
+    coalesce: bool = True
+    # JSON results at most this big are pre-serialized at population time so
+    # a hit's response body is one memcpy, not a per-request json.dumps.
+    max_body_bytes: int = 1048576
+
+    def __post_init__(self) -> None:
+        if self.capacity < 1:
+            raise ValueError(f"cache.capacity must be >= 1, got {self.capacity}")
+        if self.ttl_s < 0 or self.max_body_bytes < 0:
+            raise ValueError("cache.ttl_s/max_body_bytes must be >= 0")
+
+
+@dataclass
+class AdaptiveConfig:
+    """SLO-aware adaptive batching (``[adaptive]`` TOML; tpuserve.batcher,
+    docs/PERFORMANCE.md "Adaptive batching").
+
+    Replaces the fixed max-wait flush with an AIMD-adjusted per-group target
+    batch size (Clipper P1) plus a deadline-headroom bound from the per-bucket
+    batch-duration EWMA (Clockwork P3): under light load the target decays to
+    ``min_target`` and batches flush immediately; under sustained load it
+    climbs to the largest bucket and batches fill. ``deadline_ms`` stays as
+    the max-wait backstop."""
+
+    enabled: bool = True
+    # Floor of the AIMD target batch size.
+    min_target: int = 1
+    # Starting target per group; 0 = the model's largest batch bucket (the
+    # pre-adaptive behavior, so cold groups favor throughput).
+    initial_target: int = 0
+    # Additive increase applied when a batch fills to target with more work
+    # still queued (arrivals outpace the target: grow it).
+    increase: float = 1.0
+    # Multiplicative decrease applied on a timer-driven partial flush
+    # (arrivals can't fill the target: shrink it toward min_target).
+    decrease: float = 0.5
+    # Smoothing factor for the per-bucket batch-duration EWMA.
+    ewma_alpha: float = 0.2
+    # Safety margin (ms) subtracted with the EWMA from the earliest request
+    # deadline when computing the flush headroom bound.
+    slack_ms: float = 2.0
+
+    def __post_init__(self) -> None:
+        if self.min_target < 1 or self.initial_target < 0:
+            raise ValueError(
+                "adaptive.min_target must be >= 1 and initial_target >= 0")
+        if self.increase <= 0 or not 0.0 < self.decrease <= 1.0:
+            raise ValueError(
+                "adaptive.increase must be > 0 and decrease in (0, 1]")
+        if not 0.0 < self.ewma_alpha <= 1.0 or self.slack_ms < 0:
+            raise ValueError(
+                "adaptive.ewma_alpha must be in (0, 1] and slack_ms >= 0")
+
+
+@dataclass
 class ModelConfig:
     """Per-model serving configuration."""
 
@@ -328,6 +403,11 @@ class ServerConfig:
     log_json: bool = False
     # Pipelined host execution engine knobs (stage pools, depth, arenas).
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    # Content-addressed result cache + single-flight coalescing (off by
+    # default: only correct for models deterministic in their input).
+    cache: CacheConfig = field(default_factory=CacheConfig)
+    # SLO-aware adaptive batching (AIMD target batch size per group).
+    adaptive: AdaptiveConfig = field(default_factory=AdaptiveConfig)
     # Deterministic fault injection (chaos testing); disabled by default.
     faults: FaultsConfig = field(default_factory=FaultsConfig)
     # Versioned reload lifecycle (integrity checks, staged canary, rollback).
@@ -374,6 +454,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     faults_dict = raw.pop("faults", None)
     lifecycle_dict = raw.pop("lifecycle", None)
     pipeline_dict = raw.pop("pipeline", None)
+    cache_dict = raw.pop("cache", None)
+    adaptive_dict = raw.pop("adaptive", None)
     cfg: ServerConfig = _build(ServerConfig, raw)
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
     if dist_dict is not None:
@@ -382,6 +464,10 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
         cfg.lifecycle = _build(LifecycleConfig, lifecycle_dict)
     if pipeline_dict is not None:
         cfg.pipeline = _build(PipelineConfig, pipeline_dict)
+    if cache_dict is not None:
+        cfg.cache = _build(CacheConfig, cache_dict)
+    if adaptive_dict is not None:
+        cfg.adaptive = _build(AdaptiveConfig, adaptive_dict)
     if faults_dict is not None:
         rule_dicts = faults_dict.pop("rule", [])
         cfg.faults = _build(FaultsConfig, faults_dict)

@@ -168,6 +168,15 @@ PIPELINE_STAGES = ("assemble", "h2d", "fetch", "postproc")
 # so "bigger = less healthy" reads naturally on a dashboard.
 BREAKER_STATES = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 
+# Demand-shaping cache events (tpuserve.cache): the ``cache_<event>_total``
+# per-model counters. "hits" answer from the cache, "misses" lead a real
+# batch submission, "coalesced" join an identical in-flight miss
+# (single-flight), "evictions" are LRU drops, and "stale_drops" are flights
+# that completed after a mid-flight version change (served to their waiters
+# but never cached). hits/misses/coalesced are disjoint per request item, so
+# cache traffic can never inflate miss-path throughput numbers.
+CACHE_EVENTS = ("hits", "misses", "coalesced", "evictions", "stale_drops")
+
 # Lifecycle reload gates, in pipeline order (tpuserve.lifecycle): the stage
 # label on reload_rejected_total{model=,stage=}. "post_canary" is the only
 # one that implies a rollback happened (the candidate had published).
@@ -216,6 +225,12 @@ class Metrics:
     # -- convenience --------------------------------------------------------
     def observe_phase(self, model: str, phase: str, ms: float) -> None:
         self.histogram(f"latency_ms{{model={model},phase={phase}}}").observe(ms)
+
+    def cache_counter(self, model: str, event: str) -> Counter:
+        """cache_<event>_total{model=}: one of CACHE_EVENTS
+        (tpuserve.cache). Prebound by ModelCache at construction — never
+        call this per request."""
+        return self.counter(f"cache_{event}_total{{model={model}}}")
 
     def set_model_version(self, model: str, version: int) -> None:
         """model_version{model=}: the live weight-tree version number

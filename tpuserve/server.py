@@ -48,6 +48,7 @@ import jax
 from tpuserve import models as modelzoo
 from tpuserve.analysis import witness
 from tpuserve.batcher import DeadlineExceeded, ModelBatcher, QueueFull
+from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
 from tpuserve.hostpipe import StageExecutors
@@ -61,6 +62,34 @@ _VERBS = ("predict", "classify", "detect", "generate")
 
 # Typed aiohttp app key (string keys are deprecated).
 STATE_KEY: "web.AppKey[ServerState]" = web.AppKey("tpuserve_state", object)
+
+# Client batches at least this big JSON-encode off the event loop (the
+# encode for a full bucket of top-k results is hundreds of microseconds —
+# enough to stall every other in-flight response at high request rates).
+# Smaller responses stay inline: the executor hop costs more than it saves.
+_JSON_OFFLOAD_MIN_ITEMS = 32
+
+
+def _dumps_utf8(obj) -> bytes:
+    return json.dumps(obj).encode("utf-8")
+
+
+class ModelHandles:
+    """Per-model hot-path state hoisted out of handle_predict (ISSUE 5):
+    prebound metric objects and config, built once at start(). The handler
+    previously paid an f-string format plus a locked registry lookup per
+    counter per request, and a linear config scan per request."""
+
+    __slots__ = ("mcfg", "requests", "bad_requests", "timeouts", "total_hist")
+
+    def __init__(self, name: str, mcfg, metrics: Metrics) -> None:
+        self.mcfg = mcfg
+        self.requests = metrics.counter(f"requests_total{{model={name}}}")
+        self.bad_requests = metrics.counter(
+            f"bad_requests_total{{model={name}}}")
+        self.timeouts = metrics.counter(f"timeouts_total{{model={name}}}")
+        self.total_hist = metrics.histogram(
+            f"latency_ms{{model={name},phase=total}}")
 
 
 class ServerState:
@@ -81,6 +110,12 @@ class ServerState:
         # Versioned reload lifecycle (tpuserve.lifecycle); direct-mode
         # runtimes only — recycle-mode workers own their params.
         self.lifecycles: dict[str, ModelLifecycle] = {}
+        # Demand-shaping layer (tpuserve.cache): per-model result cache +
+        # single-flight coalescing; empty unless [cache] enabled.
+        self.caches: dict[str, ModelCache] = {}
+        # Prebound per-model hot-path handles (metrics + config), built at
+        # start() so handle_predict does zero registry lookups per request.
+        self.handles: dict[str, ModelHandles] = {}
         self.canary_ok: dict[str, bool] = {}
         self._canary_task: asyncio.Task | None = None
         # Chaos layer (docs/ROBUSTNESS.md): None unless [faults] is armed.
@@ -143,9 +178,18 @@ class ServerState:
             b = ModelBatcher(model, rt, self.metrics, self.pool,
                              breaker=br, injector=self.injector,
                              stages=self.stages,
-                             pipeline_cfg=self.cfg.pipeline)
+                             pipeline_cfg=self.cfg.pipeline,
+                             adaptive_cfg=self.cfg.adaptive)
             await b.start()
             self.batchers[name] = b
+            self.handles[name] = ModelHandles(name, model.cfg, self.metrics)
+            if self.cfg.cache.enabled:
+                # Keys carry the LIVE runtime version, so a lifecycle
+                # publish/rollback atomically invalidates older entries;
+                # recycle-mode pools have no in-process version and pin 0.
+                self.caches[name] = ModelCache(
+                    name, self.cfg.cache, self.metrics,
+                    version_fn=functools.partial(getattr, rt, "version", 0))
             self.watchdog.register(name, "group_loop", b.revive_group_loops)
             if hasattr(rt, "watchdog_sweep"):
                 self.watchdog.register(name, "worker", rt.watchdog_sweep)
@@ -305,9 +349,9 @@ async def handle_predict(request: web.Request) -> web.Response:
         breaker.on_shed()
         return _err(503, f"circuit open for model {name!r}; recovery probe "
                          "in progress", retry_after=state.breaker_retry_after(name))
-    mcfg = state.cfg.model(name)
-    metrics = state.metrics
-    metrics.counter(f"requests_total{{model={name}}}").inc()
+    h = state.handles[name]
+    mcfg = h.mcfg
+    h.requests.inc()
     t_start = time.perf_counter()
 
     body = await request.read()
@@ -340,14 +384,38 @@ async def handle_predict(request: web.Request) -> web.Response:
         if not items:
             raise ValueError("empty batch")
     except Exception as e:
-        metrics.counter(f"bad_requests_total{{model={name}}}").inc()
+        h.bad_requests.inc()
         return _err(400, f"could not decode request: {e}")
 
-    futs = []
+    # Demand-shaping layer (tpuserve.cache): per item, answer from the
+    # content-addressed result cache, join an identical in-flight miss
+    # (single-flight: one batch slot, the result fanned out), or lead a
+    # fresh batcher submission. Hit/miss/coalesced are counted disjointly
+    # so cache traffic never masquerades as model throughput.
+    cache = state.caches.get(name)
+    batcher = state.batchers[name]
+    results: list = [None] * len(items)
+    futs: list[asyncio.Future] = []
+    slots: list[int] = []
+    hit_entry = None
     try:
-        for item in items:
-            futs.append(state.batchers[name].submit(
-                item, group=model.group_key(item), deadline_at=deadline_at))
+        for i, item in enumerate(items):
+            if cache is not None:
+                key = cache.key_for(item)
+                entry = cache.get(key)
+                if entry is not None:
+                    results[i] = entry.value
+                    hit_entry = entry
+                    continue
+                fut = cache.submit_through(
+                    key, lambda it=item: batcher.submit(
+                        it, group=model.group_key(it),
+                        deadline_at=deadline_at))
+            else:
+                fut = batcher.submit(item, group=model.group_key(item),
+                                     deadline_at=deadline_at)
+            futs.append(fut)
+            slots.append(i)
     except QueueFull:
         for f in futs:
             f.cancel()
@@ -360,37 +428,55 @@ async def handle_predict(request: web.Request) -> web.Response:
             f.cancel()
         return _err(503, f"server not accepting requests: {e}")
 
-    try:
-        remaining = max(0.0, deadline_at - time.perf_counter())
-        # With an explicit client deadline the batcher enforces it precisely
-        # at flush time (fast 504 + deadline_exceeded_total); the HTTP timer
-        # then runs slightly late as a pure backstop so the two never race.
-        grace = 0.25 if timeout_ms is not None else 0.0
-        results = await asyncio.wait_for(asyncio.gather(*futs),
-                                         timeout=remaining + grace)
-    except asyncio.TimeoutError:
-        for f in futs:
-            f.cancel()
-        metrics.counter(f"timeouts_total{{model={name}}}").inc()
-        return _err(504, f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
-    except DeadlineExceeded as e:
-        # The batcher rejected the queued work before dispatch: same 504 as
-        # the timer path, but fast, and counted in deadline_exceeded_total.
-        for f in futs:
-            f.cancel()
-        return _err(504, f"deadline_exceeded: {e}")
-    except Exception as e:
-        for f in futs:
-            f.cancel()
-        return _err(500, f"inference failed: {e}")
+    if futs:
+        try:
+            remaining = max(0.0, deadline_at - time.perf_counter())
+            # With an explicit client deadline the batcher enforces it
+            # precisely at flush time (fast 504 + deadline_exceeded_total);
+            # the HTTP timer then runs slightly late as a pure backstop so
+            # the two never race.
+            grace = 0.25 if timeout_ms is not None else 0.0
+            done = await asyncio.wait_for(asyncio.gather(*futs),
+                                          timeout=remaining + grace)
+        except asyncio.TimeoutError:
+            for f in futs:
+                f.cancel()
+            h.timeouts.inc()
+            return _err(504,
+                        f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
+        except DeadlineExceeded as e:
+            # The batcher rejected the queued work before dispatch: same 504
+            # as the timer path, but fast, in deadline_exceeded_total.
+            for f in futs:
+                f.cancel()
+            return _err(504, f"deadline_exceeded: {e}")
+        except Exception as e:
+            for f in futs:
+                f.cancel()
+            return _err(500, f"inference failed: {e}")
+        for i, res in zip(slots, done):
+            results[i] = res
 
     total_ms = (time.perf_counter() - t_start) * 1e3
-    metrics.observe_phase(name, "total", total_ms)
+    h.total_hist.observe(total_ms)
     if batched:
-        return web.json_response({"results": list(results)})
+        payload = {"results": results}
+        if len(results) >= _JSON_OFFLOAD_MIN_ITEMS and not state.cfg.decode_inline:
+            # Large batched responses encode off the loop (egress fast
+            # path); single-core hosts (decode_inline) stay inline — the
+            # executor hop costs more than the encode there.
+            raw = await asyncio.get_running_loop().run_in_executor(
+                state.pool, _dumps_utf8, payload)
+            return web.Response(body=raw, content_type="application/json")
+        return web.json_response(payload)
     result = results[0]
     if isinstance(result, bytes):  # e.g. SD PNG output
         return web.Response(body=result, content_type="image/png")
+    if hit_entry is not None and hit_entry.body is not None:
+        # Cache-hit egress fast path: the response bytes were serialized
+        # once at population time; a hit is one memcpy, zero json.dumps.
+        return web.Response(body=hit_entry.body,
+                            content_type="application/json")
     return web.json_response(result)
 
 
@@ -445,6 +531,10 @@ async def handle_stats(request: web.Request) -> web.Response:
         "stages": state.stages.stats(),
         "models": {n: b.pipeline_stats() for n, b in state.batchers.items()},
     }
+    # Demand-shaping layer: per-model result-cache occupancy and the
+    # hit/miss/coalesced/stale accounting (docs/PERFORMANCE.md).
+    if state.caches:
+        out["cache"] = {n: c.stats() for n, c in state.caches.items()}
     return web.json_response(out)
 
 
