@@ -29,4 +29,7 @@ scripts/pipeline_smoke.sh
 echo "== cache smoke (hit-heavy / reload churn / miss-only parity) =="
 scripts/cache_smoke.sh
 
+echo "== roofline smoke (variant registry / zero recompiles / compute split) =="
+scripts/roofline_smoke.sh
+
 echo "chaos smoke OK"

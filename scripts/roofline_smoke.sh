@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Roofline smoke (ISSUE 6): a short closed loop through the REAL server on
+# the CPU backend proving the compute fast path end to end:
+#   1. the specialized-variant registry is live: runtime_variants > 0 and
+#      per-variant serving counters (runtime_variant_batches_total) move;
+#   2. steady state recompiles NOTHING: the runtime_compiles_total delta
+#      across warm load + a :reload publish is exactly 0;
+#   3. the /stats roofline block is well-formed: every bucket carries a
+#      raw-executable ceiling (roofline_probe_iters armed the startup
+#      probe) and the serving compute phase splits into device-time vs
+#      host-wait with a sane pct-of-ceiling.
+# Run by CI next to the chaos/reload/pipeline/cache drills; see
+# docs/PERFORMANCE.md "Reading the roofline".
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): the registry and
+# probe paths run under witnessed locks + per-suspension held-lock checks.
+export TPUSERVE_LOCK_WITNESS=1
+
+python - <<'EOF'
+import asyncio
+
+import aiohttp
+from aiohttp import web
+
+from tpuserve.bench.loadgen import run_load, synthetic_pool
+from tpuserve.config import ModelConfig, ServerConfig
+from tpuserve.server import ServerState, make_app
+
+NPY = "application/x-npy"
+
+cfg = ServerConfig(
+    decode_threads=2,
+    startup_canary=False,
+    roofline_probe_iters=4,
+    models=[ModelConfig(
+        name="toy", family="toy", batch_buckets=[1, 2, 4],
+        deadline_ms=5.0, dtype="float32", num_classes=10,
+        parallelism="single", request_timeout_ms=10_000.0,
+        wire_size=8, max_inflight=2,
+    )],
+)
+
+
+async def scrape(base: str, session) -> tuple[dict, dict]:
+    async with session.get(f"{base}/metrics") as r:
+        text = await r.text()
+    metrics = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        k, v = line.rsplit(" ", 1)
+        try:
+            metrics[k] = float(v)
+        except ValueError:
+            pass
+    async with session.get(f"{base}/stats") as r:
+        stats = await r.json()
+    return metrics, stats
+
+
+async def main() -> None:
+    state = ServerState(cfg)
+    state.build()
+    runner = web.AppRunner(make_app(state), access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    base = f"http://127.0.0.1:{runner.addresses[0][1]}"
+    pool = synthetic_pool("npy", 16, edge=8)
+    try:
+        # Warm load, then the measured window the compile delta spans.
+        res = await run_load(f"{base}/v1/models/toy:classify", pool, NPY,
+                             duration_s=2.0, warmup_s=0.5, concurrency=8)
+        assert res.n_err == 0 and res.n_ok > 0, res.summary()
+        async with aiohttp.ClientSession() as s:
+            m0, _ = await scrape(base, s)
+            res2 = await run_load(f"{base}/v1/models/toy:classify", pool, NPY,
+                                  duration_s=2.0, warmup_s=0.0, concurrency=8)
+            assert res2.n_err == 0 and res2.n_ok > 0, res2.summary()
+            # Lifecycle churn rides the same steady state: a publish swaps
+            # trees under unchanged shapes, so it may not compile either.
+            async with s.post(f"{base}/admin/models/toy:reload") as r:
+                assert r.status == 200, await r.text()
+            m1, stats = await scrape(base, s)
+
+        key = 'runtime_compiles_total{model="toy"}'
+        assert m0.get(key, 0) > 0, f"no compiles recorded at startup: {m0}"
+        delta = m1.get(key, 0) - m0.get(key, 0)
+        assert delta == 0, f"steady state recompiled: delta={delta}"
+        assert m1.get('runtime_variants{model="toy"}', 0) == 3, m1
+        served = [v for k, v in m1.items()
+                  if k.startswith("runtime_variant_batches_total") and v > 0]
+        assert served, f"no specialized-variant serving counters moved: {m1}"
+
+        roof = stats["roofline"]["toy"]
+        assert len(roof["variants"]) == 3, roof
+        assert set(roof["raw_ms_per_batch"]) == {"[1]", "[2]", "[4]"}, roof
+        assert all(v and v > 0 for v in roof["raw_ms_per_batch"].values())
+        split = roof["compute_split"]
+        assert split["device_ms"] > 0 and split["host_wait_ms"] >= 0, split
+        assert 0 < split["pct_of_ceiling"] <= 100, split
+        print(f"roofline smoke OK: {res2.throughput:.1f}/s, "
+              f"compiles delta 0 (total {m1[key]:.0f}), variants 3, "
+              f"compute split {split}")
+    finally:
+        await runner.cleanup()
+
+
+asyncio.run(main())
+EOF

@@ -198,6 +198,11 @@ class ModelBatcher:
             self.depth = 0
         else:
             n_rep = max(1, int(getattr(self.runtime, "n_replicas", 1)))
+            if hasattr(self.runtime, "h2d_sync"):
+                # Transfer-completion gate ([pipeline] h2d_sync): the h2d
+                # stage owns the wire wait, so the "compute" phase measures
+                # dispatch-to-ready only (roofline attribution).
+                self.runtime.h2d_sync = pcfg.h2d_sync
             self.depth = max(1, pcfg.depth or self.cfg.max_inflight)
             self._staging = [SlotPool(self.depth) for _ in range(n_rep)]
             self._admission_cap = self.depth * n_rep + pcfg.assemble_ahead

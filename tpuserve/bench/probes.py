@@ -101,6 +101,12 @@ def measure_h2d_mbps(mode: str = "virgin", timeout: float = 600.0,
 CHIP_PROBE_SRC = textwrap.dedent("""
     import time, json, sys, numpy as np, jax, jax.numpy as jnp
     sys.path.insert(0, %(repo)r)
+    cache = %(cache)r
+    if cache:
+        # Share the serving process's persistent XLA cache: per-bucket
+        # roofline probes then cost one compile EVER, not one per bench run.
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     from tpuserve.config import ModelConfig
     from tpuserve.models import build
     mcfg = dict(%(mcfg)r)
@@ -252,7 +258,8 @@ def measure_chip_img_s(batch: int | None = None, family: str = "resnet50",
                        iters: int | None = None, timeout: float = 1800.0,
                        repo: str | None = None,
                        bucket: tuple | None = None,
-                       mcfg_extra: dict | None = None) -> dict:
+                       mcfg_extra: dict | None = None,
+                       cache_dir: str | None = None) -> dict:
     """Device-resident serving-forward rate + FLOP count, fresh subprocess.
 
     `family` must be a CHIP_PROBE_FAMILIES preset (the r4 foot-gun of
@@ -260,7 +267,9 @@ def measure_chip_img_s(batch: int | None = None, family: str = "resnet50",
     error up front). `batch`/`bucket`/`iters` override the preset;
     `mcfg_extra` shallow-merges over the preset's ModelConfig kwargs (e.g.
     {"seq_buckets": [512], "options": {"attention": "flash"}} for the
-    flash-vs-dense sweep).
+    flash-vs-dense sweep). `cache_dir` points the subprocess at a
+    persistent XLA compilation cache (bench.py passes the server's own, so
+    per-bucket roofline probes compile once ever, not once per run).
 
     Returns {"img_s", "ms_per_batch", "batch", "bucket", "gflops_per_item",
     "achieved_tflops_s", "mfu_pct"?, "device"} or {"error": str}.
@@ -279,7 +288,8 @@ def measure_chip_img_s(batch: int | None = None, family: str = "resnet50",
         os.path.abspath(__file__))))
     src = CHIP_PROBE_SRC % {"repo": repo, "mcfg": mcfg,
                             "bucket": bkt,
-                            "iters": iters or preset["iters"]}
+                            "iters": iters or preset["iters"],
+                            "cache": cache_dir or ""}
     try:
         proc = subprocess.run([sys.executable, "-c", src], capture_output=True,
                               text=True, timeout=timeout, cwd=repo)

@@ -141,6 +141,12 @@ class PipelineConfig:
     # depth + assemble_ahead. Acquires beyond this fall back to one-shot
     # allocations counted in arena_overflow_total{model=}.
     arena_slots: int = 0
+    # Block the h2d stage until the transfer completes, so the "h2d" phase
+    # owns the wire wait and "compute" measures dispatch-to-ready only
+    # (roofline attribution, docs/PERFORMANCE.md "Reading the roofline").
+    # The block lands on a dedicated h2d stage thread the link serializes
+    # anyway, so throughput is unaffected; false restores buffered puts.
+    h2d_sync: bool = True
 
     def __post_init__(self) -> None:
         for f in ("assemble_workers", "h2d_workers", "fetch_workers",
@@ -396,6 +402,12 @@ class ServerConfig:
     # Run every compiled executable once at startup so first requests don't
     # pay PJRT program load (runtime.ModelRuntime.prewarm).
     prewarm_executables: bool = True
+    # > 0: after prewarm, time each bucket's raw executable with this many
+    # back-to-back dispatches (inputs resident, one dependent read) so the
+    # /stats "roofline" block can split the serving compute phase into
+    # device-time vs host-wait (docs/PERFORMANCE.md "Reading the roofline").
+    # 0 disables the startup probe (the bench runs its own in a subprocess).
+    roofline_probe_iters: int = 0
     # Observability: max request-trace events kept for /debug/trace.
     trace_capacity: int = 65536
     # Emit one JSON object per log line (machine-ingestible) instead of the

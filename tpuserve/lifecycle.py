@@ -132,6 +132,22 @@ class ModelLifecycle:
             except Exception as e:  # noqa: BLE001 — e.g. unreadable ckpt
                 self._reject("load", e)
 
+            # Variant completeness gate (ISSUE 6): every configured bucket's
+            # specialized executable must be resident BEFORE the staged
+            # canary runs, so neither the canary nor the first post-publish
+            # request ever pays a first-compile. Steady state (shapes
+            # unchanged across versions) this compiles nothing — the
+            # runtime_compiles_total delta stays 0 across reload churn.
+            if hasattr(self.runtime, "ensure_compiled"):
+                try:
+                    n_new = await loop.run_in_executor(
+                        None, self.runtime.ensure_compiled)
+                    if n_new:
+                        log.info("%s: compiled %d missing variant(s) at "
+                                 "stage time", self.name, n_new)
+                except Exception as e:  # noqa: BLE001 — XLA compile failure
+                    self._reject("load", e)
+
             if self.cfg.staged_canary:
                 try:
                     if self.injector is not None:

@@ -108,9 +108,27 @@ class ServingModel(abc.ABC):
         return (self.cfg.batch_buckets[-1],)
 
     # -- device-side --------------------------------------------------------
+    def device_preprocess(self, batch: HostBatch) -> Any:
+        """Jittable fused-preprocessing seam: raw wire bytes -> network input.
+
+        The wire contract ships exactly what the host decoded — uint8 RGB or
+        YUV420 planes for vision, token ids for text — and EVERY cast,
+        /255 scale, normalize, resize, and colorspace conversion happens
+        here, inside the compiled program, where XLA fuses it into the
+        network's first consumers. ``forward`` implementations must route
+        their input through this method (rather than open-coding the math)
+        so the fusion is a named, testable, probe-able boundary: the
+        roofline attribution compiles ``device_preprocess`` standalone to
+        price the fused-preproc share of the executable, and tests assert
+        ``forward(params, wire) == net(device_preprocess(wire))``. Identity
+        by default for families whose network consumes the wire format
+        directly (e.g. token ids)."""
+        return batch
+
     @abc.abstractmethod
     def forward(self, params: Any, batch: HostBatch) -> Outputs:
-        """Jittable: on-device preproc + network + on-device postproc."""
+        """Jittable: on-device preproc (via ``device_preprocess``) + network
+        + on-device postproc."""
 
     def prepare_host_params(self, params: Any) -> Any:
         """Restructure loaded host params for the serving mode before

@@ -464,7 +464,7 @@ class EfficientDetServing(ImageClassifierServing):
         return full
 
     def forward(self, params: Any, batch: Any) -> dict:
-        x = self.prepare_batch(batch)
+        x = self.device_preprocess(batch)
         cls_logits, box_reg = self.module.apply(params, x)  # (B,A,C), (B,A,4)
         probs = jax.nn.sigmoid(cls_logits)
         best = jnp.max(probs, axis=-1)                      # (B, A)

@@ -40,8 +40,12 @@ class ToyServing(ServingModel):
         (b,) = bucket
         return jax.ShapeDtypeStruct((b, EDGE, EDGE, 3), jnp.uint8)
 
+    def device_preprocess(self, batch: jax.Array) -> jax.Array:
+        """Fused-preproc seam: uint8 wire -> flattened [0,1] compute-dtype."""
+        return batch.astype(self.dtype).reshape(batch.shape[0], -1) / 255.0
+
     def forward(self, params: Any, batch: jax.Array) -> dict:
-        x = batch.astype(self.dtype).reshape(batch.shape[0], -1) / 255.0
+        x = self.device_preprocess(batch)
         h = jnp.tanh(x @ params["w1"].astype(self.dtype) + params["b1"].astype(self.dtype))
         logits = h @ params["w2"].astype(self.dtype) + params["b2"].astype(self.dtype)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)

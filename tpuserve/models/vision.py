@@ -56,9 +56,12 @@ class ImageClassifierServing(ServingModel):
             )
         return jax.ShapeDtypeStruct((b, w, w, 3), jnp.uint8)
 
-    def prepare_batch(self, batch: Any) -> Any:
-        """Wire-format dispatch: device-side unpack/resize/normalize (jittable).
-        Shared by every vision family (classifiers and detection)."""
+    def device_preprocess(self, batch: Any) -> Any:
+        """Wire-format dispatch: device-side unpack/resize/normalize
+        (jittable), fused by XLA into the first conv. Raw uint8 RGB or
+        YUV420 planes in, normalized compute-dtype NHWC out — the fused-
+        preproc seam (ServingModel.device_preprocess) shared by every
+        vision family (classifiers and detection)."""
         if self.cfg.wire_format == "yuv420":
             y, u, v = batch
             return preproc.device_prepare_images_yuv420(
@@ -67,8 +70,13 @@ class ImageClassifierServing(ServingModel):
         return preproc.device_prepare_images(batch, self.cfg.image_size, dtype=self.dtype,
                                              mean=self.norm_mean, std=self.norm_std)
 
+    def prepare_batch(self, batch: Any) -> Any:
+        """Historical name for ``device_preprocess`` (training utilities and
+        parity tests call it); same function."""
+        return self.device_preprocess(batch)
+
     def forward(self, params: Any, batch: Any) -> dict:
-        x = self.prepare_batch(batch)
+        x = self.device_preprocess(batch)
         logits = self.module.apply(params, x)
         probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
         top_p, top_i = jax.lax.top_k(probs, self.top_k)

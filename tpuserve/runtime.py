@@ -41,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpuserve.config import ModelConfig, ServerConfig
 from tpuserve.models.base import ServingModel
+from tpuserve.obs import Metrics
 from tpuserve.parallel import make_mesh, match_partition_rules
 from tpuserve.parallel.mesh import MeshPlan
 from tpuserve.parallel.partition import specs_to_shardings
@@ -73,6 +74,54 @@ class Executable:
     batch_sharding: Any  # pytree of NamedSharding for the batch input
     device_index: int = 0  # replica mode: which replica
     donated: bool = False  # batch input buffers donated to the outputs
+
+
+@dataclass(frozen=True)
+class VariantKey:
+    """Identity of one fully-specialized compiled variant (ISSUE 6).
+
+    Clockwork's premise (PAPERS.md P3) is that predictable serving comes
+    from precompiled, fully-specialized executables managed bottom-up; the
+    registry keys each one by everything the compilation specialized on —
+    the static batch/seq bucket, the compute dtype, the quantization mode,
+    and the parallelism layout. TF-Serving's servable discipline (P2) adds
+    the second half: variants must be cheaply enumerable artifacts, so
+    `/v1/models` and `/stats` can list exactly what is resident, and a
+    counter (`runtime_compiles_total`) can prove the steady state compiles
+    nothing new. Weight versions are deliberately NOT part of the key:
+    publish/rollback swap trees under unchanged shapes, so every version
+    reuses the same variant set (zero recompiles across reloads)."""
+
+    bucket: tuple
+    dtype: str
+    quantize: str | None
+    parallelism: str
+
+    @property
+    def label(self) -> str:
+        """Compact metric-label form: "<bucket>/<dtype>/<quantize>/<mode>"."""
+        b = "x".join(str(d) for d in self.bucket)
+        return f"{b}/{self.dtype}/{self.quantize or 'fp'}/{self.parallelism}"
+
+
+@dataclass
+class Variant:
+    """Registry entry: one VariantKey's executables across replicas."""
+
+    key: VariantKey
+    executables: list[Executable]
+    compile_ms: float = 0.0
+
+    def summary(self) -> dict:
+        return {
+            "bucket": list(self.key.bucket),
+            "dtype": self.key.dtype,
+            "quantize": self.key.quantize,
+            "parallelism": self.key.parallelism,
+            "replicas": len(self.executables),
+            "donated": any(e.donated for e in self.executables),
+            "compile_ms": round(self.compile_ms, 1),
+        }
 
 
 def _leaves_with_shardings(struct: Any, shardings: Any) -> list[tuple]:
@@ -116,9 +165,13 @@ def _donation_shapes_ok(batch_struct: Any, batch_shardings: Any,
 class ModelRuntime:
     """Owns params-on-device and the compiled executable set for one model."""
 
-    def __init__(self, model: ServingModel, mesh: Mesh | None = None) -> None:
+    def __init__(self, model: ServingModel, mesh: Mesh | None = None,
+                 metrics: Metrics | None = None) -> None:
         self.model = model
         self.cfg: ModelConfig = model.cfg
+        # A private registry when the caller has none (direct construction in
+        # tests/probes): the counters still work, they just aren't scraped.
+        self.metrics = metrics if metrics is not None else Metrics()
         self.mode = self.cfg.parallelism
         if self.mode not in ("sharded", "replica", "single", "pipeline"):
             raise ValueError(f"unknown parallelism mode {self.mode!r}")
@@ -176,7 +229,32 @@ class ModelRuntime:
                 self.cfg.batch_buckets = aligned
 
         self.params_per_mesh: list[Any] = []
+        # Compiled-variant registry (ISSUE 6): every executable set is keyed
+        # by the full specialization (bucket x dtype x quantize x
+        # parallelism) and cheap to enumerate; ``executables`` remains the
+        # hot-path view of the ACTIVE variant per bucket (same Executable
+        # objects — the registry adds identity and accounting, not a copy).
+        self.variants: dict[VariantKey, Variant] = {}
         self.executables: dict[tuple, list[Executable]] = {}
+        # Per-bucket raw-executable time (ms/batch), measured by
+        # probe_raw_ms with inputs already resident — the device-time term
+        # of the roofline's compute split (docs/PERFORMANCE.md).
+        self.raw_ms_per_batch: dict[tuple, float | None] = {}
+        # When True, h2d() blocks until the transfer completes so the "h2d"
+        # phase owns the wire and "compute" measures dispatch-to-ready only
+        # (roofline attribution; [pipeline] h2d_sync, set by the batcher).
+        self.h2d_sync = False
+        name = model.name
+        # Every .compile() increments this; a steady-state delta of 0 is the
+        # proof that serving repeat buckets (and publish/rollback churn)
+        # recompiles nothing (scripts/roofline_smoke.sh asserts it).
+        self._c_compiles = self.metrics.counter(
+            f"runtime_compiles_total{{model={name}}}")
+        self._g_variants = self.metrics.gauge(
+            f"runtime_variants{{model={name}}}")
+        # Batches dispatched per specialized variant, prebound at compile
+        # time (one locked inc per batch, not per request).
+        self._c_variant_batches: dict[tuple, Any] = {}
         # Versioned lifecycle (tpuserve.lifecycle): the live tree carries a
         # monotonically numbered version; publish() retains the previous tree
         # as last-known-good so rollback() is a pointer swap, not a reload.
@@ -283,6 +361,12 @@ class ModelRuntime:
                 qz.dequantize_tree_except(p, dtype, keep), batch)
         return self.model.forward
 
+    def variant_key(self, bucket: tuple) -> VariantKey:
+        """The ACTIVE variant identity for a bucket: what this runtime's
+        config specializes its executables on."""
+        return VariantKey(bucket=tuple(bucket), dtype=self.cfg.dtype,
+                          quantize=self.cfg.quantize, parallelism=self.mode)
+
     def compile_all(self, pool: cf.ThreadPoolExecutor | None = None) -> None:
         """AOT-compile every bucket (in parallel when a pool is given)."""
         t0 = time.perf_counter()
@@ -297,7 +381,36 @@ class ModelRuntime:
             self.model.name, len(buckets), len(self.meshes), time.perf_counter() - t0,
         )
 
+    def ensure_compiled(self) -> int:
+        """Compile any configured bucket missing from the variant registry;
+        returns how many variants were newly compiled.
+
+        The lifecycle calls this at STAGE time (tpuserve.lifecycle), so a
+        staged canary — and the first post-publish request — never pays a
+        first-compile: by the time a candidate tree runs, every variant it
+        can reach is resident. In the common case (shapes unchanged across
+        versions, which stage_params enforces) this is a cheap no-op whose
+        return value of 0 is itself the steady-state proof."""
+        new = 0
+        for b in self.model.buckets():
+            if self.variant_key(tuple(b)) not in self.variants:
+                self._compile_bucket(tuple(b))
+                new += 1
+        return new
+
+    @property
+    def compiles_total(self) -> float:
+        """Executables compiled over this runtime's lifetime (the
+        ``runtime_compiles_total`` counter's value)."""
+        return self._c_compiles.value
+
+    def variants_summary(self) -> list[dict]:
+        """Cheap enumeration of every resident compiled variant."""
+        return [v.summary() for _, v in sorted(
+            self.variants.items(), key=lambda kv: kv[0].bucket)]
+
     def _compile_bucket(self, bucket: tuple) -> None:
+        t0 = time.perf_counter()
         exes = []
         for i, mesh in enumerate(self.meshes):
             params = self.params_per_mesh[i]
@@ -348,7 +461,17 @@ class ModelRuntime:
             compiled = jitted.lower(params_struct, batch_struct).compile()
             exes.append(Executable(bucket, compiled, in_batch_sharding,
                                    device_index=i, donated=donate))
+        key = self.variant_key(bucket)
+        self.variants[key] = Variant(
+            key, exes, compile_ms=(time.perf_counter() - t0) * 1e3)
         self.executables[bucket] = exes
+        # Registered before the counters tick so a scrape can never observe
+        # a compile with no variant behind it.
+        self._c_compiles.inc(len(exes))
+        self._g_variants.set(len(self.variants))
+        self._c_variant_batches[bucket] = self.metrics.counter(
+            f"runtime_variant_batches_total{{model={self.model.name},"
+            f"variant={key.label}}}")
 
     # -- hot path -----------------------------------------------------------
     @property
@@ -367,9 +490,20 @@ class ModelRuntime:
     def h2d(self, bucket: tuple, host_batch: Any, replica: int = 0) -> Any:
         """Transfer stage: ONE batched device_put of the whole host pytree
         against the bucket's input shardings (a single transfer call, not a
-        tree_map of per-leaf puts). Runs on the pipeline's h2d executor."""
+        tree_map of per-leaf puts). Runs on the pipeline's h2d executor.
+
+        With ``h2d_sync`` (the [pipeline] default) the call blocks until the
+        transfer completes, so the "h2d" phase owns the wire wait and the
+        "compute" phase measures dispatch-to-ready only — without it a
+        buffered/async transfer returns instantly and its wall time silently
+        lands in "compute" (exactly the r05 465-ms-vs-24-ms ambiguity the
+        roofline split exists to name). Throughput is unaffected: the block
+        happens on a dedicated h2d stage thread the link serializes anyway."""
         exe = self.executables[bucket][replica]
-        return jax.device_put(host_batch, exe.batch_sharding)
+        dev = jax.device_put(host_batch, exe.batch_sharding)
+        if self.h2d_sync:
+            jax.block_until_ready(dev)
+        return dev
 
     def dispatch(self, bucket: tuple, dev_batch: Any, replica: int = 0,
                  params_override: list[Any] | None = None) -> Any:
@@ -383,6 +517,9 @@ class ModelRuntime:
                 time.sleep(delay)  # runs on a stage executor thread
             self.injector.check("device_error", self.model.name)
         exe = self.executables[bucket][replica]
+        c = self._c_variant_batches.get(bucket)
+        if c is not None:
+            c.inc()
         params = (params_override if params_override is not None
                   else self.params_per_mesh)
         return exe.compiled(params[replica], dev_batch)
@@ -432,6 +569,50 @@ class ModelRuntime:
             self.fetch(out)
         log.info("%s: prewarmed %d executable(s) in %.1fs",
                  self.model.name, len(pending), time.perf_counter() - t0)
+
+    # -- roofline probes ------------------------------------------------------
+    def probe_raw_ms(self, bucket: tuple, iters: int = 8,
+                     replica: int = 0) -> float | None:
+        """Raw-executable time for one bucket (ms/batch), inputs resident.
+
+        ``iters`` back-to-back async dispatches against an already-
+        transferred device batch, closed by ONE dependent D2H read — the
+        wire never appears in the window, so this is the device-time
+        ceiling the serving "compute" phase is measured against
+        (docs/PERFORMANCE.md "Reading the roofline"). Donated variants are
+        skipped (None): re-dispatching a donated buffer is a use-after-
+        donate, and re-transferring per iteration would put the wire back
+        in the window. Call after prewarm (PJRT program load out of the
+        way) and before the injector is armed."""
+        exes = self.executables.get(bucket)
+        if not exes or exes[replica].donated:
+            self.raw_ms_per_batch[bucket] = None
+            return None
+        struct = self.model.input_signature(bucket)
+        host = jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, s.dtype), struct)
+        dev = jax.device_put(host, exes[replica].batch_sharding)
+        jax.block_until_ready(dev)
+        self.fetch(self.dispatch(bucket, dev, replica))  # warm the window
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(max(1, iters)):
+            out = self.dispatch(bucket, dev, replica)
+        self.fetch(out)  # dependent read: the only honest completion signal
+        ms = (time.perf_counter() - t0) / max(1, iters) * 1e3
+        self.raw_ms_per_batch[bucket] = round(ms, 3)
+        return ms
+
+    def probe_all_raw(self, iters: int = 8) -> dict[tuple, float | None]:
+        """probe_raw_ms over every compiled bucket; returns the map (also
+        retained on the runtime for /stats roofline attribution)."""
+        t0 = time.perf_counter()
+        for bucket in sorted(self.executables):
+            self.probe_raw_ms(bucket, iters=iters)
+        log.info("%s: raw-executable probes %s in %.1fs", self.model.name,
+                 {str(b): v for b, v in sorted(self.raw_ms_per_batch.items())},
+                 time.perf_counter() - t0)
+        return dict(self.raw_ms_per_batch)
 
     # -- versioned weight lifecycle ------------------------------------------
     #
@@ -563,13 +744,18 @@ class ModelRuntime:
             "replicas": len(self.meshes),
             "mesh_shape": dict(self.meshes[0].shape),
             "buckets": [list(b) for b in sorted(self.executables)],
+            # Specialized-variant registry: what is compiled-resident, with
+            # what it was specialized on (ISSUE 6; enumerable per P2).
+            "variants": self.variants_summary(),
+            "compiles_total": self.compiles_total,
             "params": tree_summary(self.params_per_mesh[0]) if self.params_per_mesh else {},
         }
 
 
 def build_runtime(model: ServingModel, mesh: Mesh | None = None,
-                  pool: cf.ThreadPoolExecutor | None = None) -> ModelRuntime:
-    rt = ModelRuntime(model, mesh)
+                  pool: cf.ThreadPoolExecutor | None = None,
+                  metrics: Metrics | None = None) -> ModelRuntime:
+    rt = ModelRuntime(model, mesh, metrics=metrics)
     rt.load_and_shard_params()
     rt.compile_all(pool)
     return rt

@@ -48,6 +48,7 @@ import jax
 from tpuserve import models as modelzoo
 from tpuserve.analysis import witness
 from tpuserve.batcher import DeadlineExceeded, ModelBatcher, QueueFull
+from tpuserve.bench.roofline import compute_split, phase_p50
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
@@ -147,9 +148,17 @@ class ServerState:
                                       model, injector=self.injector)
                     rt.prewarm()
                 else:
-                    rt = build_runtime(model, pool=compile_pool)
+                    rt = build_runtime(model, pool=compile_pool,
+                                       metrics=self.metrics)
                     if self.cfg.prewarm_executables:
                         rt.prewarm()
+                    if self.cfg.roofline_probe_iters > 0:
+                        # Raw-executable ceilings per bucket (inputs
+                        # resident, dependent read): the device-time term
+                        # of /stats' roofline compute split. After prewarm
+                        # (program load out of the window), before the
+                        # injector arms (probes are not chaos targets).
+                        rt.probe_all_raw(int(self.cfg.roofline_probe_iters))
                     # Armed after prewarm: chaos targets the serving path,
                     # not startup.
                     rt.injector = self.injector
@@ -293,6 +302,36 @@ class ServerState:
         for b in self.batchers.values():
             ok &= await b.drain(deadline)
         return ok
+
+    def roofline(self, latency_summary: dict) -> dict:
+        """The /stats ``roofline`` block (docs/PERFORMANCE.md "Reading the
+        roofline"): per model the resident specialized variants, lifetime
+        compile count, per-bucket raw-executable ms (when
+        ``roofline_probe_iters`` armed the startup probe), and the serving
+        compute phase split into device-time vs host-wait."""
+        out: dict = {}
+        for name, rt in self.runtimes.items():
+            if not hasattr(rt, "variants"):
+                continue  # deferred pools own their executables out-of-process
+            row: dict = {
+                "variants": rt.variants_summary(),
+                "compiles_total": rt.compiles_total,
+                "raw_ms_per_batch": {
+                    str(list(b)): v
+                    for b, v in sorted(rt.raw_ms_per_batch.items())},
+            }
+            raw_vals = [v for v in rt.raw_ms_per_batch.values() if v]
+            if raw_vals:
+                # The largest probed bucket prices the split: it is what a
+                # saturated loop overwhelmingly serves, and using the
+                # biggest raw time makes host_wait a LOWER bound.
+                split = compute_split(
+                    phase_p50(latency_summary, name, "compute"),
+                    max(raw_vals))
+                if split is not None:
+                    row["compute_split"] = split
+            out[name] = row
+        return out
 
     def shed_retry_after(self) -> int:
         """Retry-After seconds for 429 shed / drain 503 responses."""
@@ -535,6 +574,12 @@ async def handle_stats(request: web.Request) -> web.Response:
     # hit/miss/coalesced/stale accounting (docs/PERFORMANCE.md).
     if state.caches:
         out["cache"] = {n: c.stats() for n, c in state.caches.items()}
+    # Compute fast path (docs/PERFORMANCE.md "Reading the roofline"):
+    # resident specialized variants, lifetime compile count, per-bucket
+    # raw-executable ceilings, and the compute device/host-wait split.
+    roofline = state.roofline(out["latency"])
+    if roofline:
+        out["roofline"] = roofline
     return web.json_response(out)
 
 
