@@ -113,14 +113,20 @@ def bench_self_check(line: dict) -> list[str]:
 
 
 def build_state(mode: str, wire_format: str, wire: int, buckets: list[int],
-                quantize: str | None):
-    from tpuserve.config import CacheConfig, ModelConfig, ServerConfig
+                quantize: str | None, parallel_mode: str = "",
+                parallel_chips: int = 0):
+    from tpuserve.config import (CacheConfig, ModelConfig, ParallelConfig,
+                                 ServerConfig)
     from tpuserve.server import ServerState
 
     cfg = ServerConfig(
         host="127.0.0.1",
         port=int(os.environ.get("BENCH_PORT", 18321)),
         decode_threads=4,
+        # Multi-chip serving plan (ISSUE 7): BENCH_PARALLEL flips the whole
+        # run between sharded-batch (default via the model's parallelism)
+        # and replica-per-chip; BENCH_NCHIPS bounds the device set.
+        parallel=ParallelConfig(mode=parallel_mode, n_chips=parallel_chips),
         # Demand-shaping layer (ISSUE 5): result cache + coalescing armed,
         # with a capacity deliberately SMALLER than the miss-pass distinct
         # pool so the measured passes are provably miss-only (LRU
@@ -244,6 +250,21 @@ def main() -> int:
     # BENCH_QUANTIZE="" for full-precision, "int8c" for int8 compute.
     quantize = os.environ.get("BENCH_QUANTIZE", "int8") or None
 
+    # Multi-chip plan (ISSUE 7): serving mode override + chip bound, plus
+    # the chip count probed in a FRESH subprocess (this process must not
+    # take the accelerator before its own link/chip probes run). The count
+    # shapes the offered load below — an 8-chip mesh driven with a
+    # single-chip connection count is demand-starved by construction.
+    parallel_mode = os.environ.get("BENCH_PARALLEL", "")
+    parallel_chips = int(env_f("BENCH_NCHIPS", 0))
+    from tpuserve.bench.probes import probe_device_count
+
+    n_chips = parallel_chips or probe_device_count(
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    print(f"# devices: {n_chips} visible "
+          f"(parallel mode {parallel_mode or 'per-model sharded'})",
+          file=sys.stderr)
+
     link_mbps = measure_link_rate_mbps()
     bpp = 1.5 if wire_format == "yuv420" else 3.0
     img_bytes = int(wire * wire * bpp)
@@ -270,10 +291,16 @@ def main() -> int:
         else:
             top = 256
         buckets = sorted({max(8, top // 2), top})
-    concurrency = int(env_f("BENCH_CONCURRENCY", min(384, max(32, 3 * max(buckets)))))
+    # Connection count scales with the chip count (ISSUE 7 satellite:
+    # ~3 top-bucket batches of closed-loop demand in flight PER CHIP).
+    from tpuserve.bench.loadgen import closed_loop_concurrency
+
+    concurrency = int(env_f("BENCH_CONCURRENCY",
+                            closed_loop_concurrency(buckets, n_chips)))
 
     print(f"# config: mode={mode} wire={wire_format}@{wire} buckets={buckets} "
-          f"concurrency={concurrency} quantize={quantize}", file=sys.stderr)
+          f"concurrency={concurrency} quantize={quantize} "
+          f"n_chips={n_chips}", file=sys.stderr)
 
     # Fresh per-run chip-compute probes (VERDICT r3 weak 2 banned the stale
     # hardcoded constant), in their own subprocesses BEFORE the server takes
@@ -304,7 +331,9 @@ def main() -> int:
                 raw_by_bucket[b] = r.get("ms_per_batch")
 
     t0 = time.time()
-    state, cfg = build_state(mode, wire_format, wire, buckets, quantize)
+    state, cfg = build_state(mode, wire_format, wire, buckets, quantize,
+                             parallel_mode=parallel_mode,
+                             parallel_chips=parallel_chips)
     print(f"# build+compile+prewarm took {time.time() - t0:.1f}s", file=sys.stderr)
 
     from tpuserve.bench.loadgen import (
@@ -457,6 +486,9 @@ def main() -> int:
 
             open_res = None
             # Open-loop rate is REQUESTS/s; closed throughput counts items.
+            # Derived from the measured closed-loop rate, so it scales with
+            # the chip count automatically — an 8-chip run is probed at 70%
+            # of its own 8-chip throughput, not of a single-chip profile.
             rate = env_f("BENCH_OPEN_RATE", 0.0) or round(
                 0.7 * closed["throughput_per_s"] / max(1, client_batch))
             if rate >= 1:
@@ -479,14 +511,14 @@ def main() -> int:
 
     # Backend provenance (ISSUE 6 satellite: BENCH_r05 said n_chips=1 while
     # MULTICHIP_r05 saw 8 devices — a reader could not tell a CPU run from
-    # a TPU run). Recorded from the serving process's own backend.
-    n_chips = 1
+    # a TPU run). Recorded from the serving process's own backend; n_chips
+    # is the count the serving path actually OCCUPIED (the runtime's mesh
+    # footprint), which [parallel] n_chips may bound below the visible set.
     backend = {}
     try:
         import jax
 
         devs = jax.devices()
-        n_chips = max(1, len(devs))
         backend = {
             "platform": jax.default_backend(),
             "device_kind": devs[0].device_kind if devs else None,
@@ -495,6 +527,16 @@ def main() -> int:
         }
     except Exception as e:  # noqa: BLE001
         backend = {"error": str(e)}
+    rt = state.runtimes.get("resnet50")
+    served = getattr(rt, "n_chips", 0)
+    n_chips = max(1, served or n_chips)
+    parallel_info = {
+        "mode": getattr(rt, "parallel_signature", mode),
+        "n_chips": n_chips,
+        "replicas": getattr(rt, "n_replicas", 1),
+        "replica_batches_total": (rt.replica_batches()
+                                  if hasattr(rt, "replica_batches") else None),
+    }
     per_chip_target = TARGET_V5E8_IMG_S / CHIPS_IN_TARGET * n_chips
 
     # Wire-ceiling consistency (ISSUE 5 satellite; r05 reported 162.7% of
@@ -522,6 +564,11 @@ def main() -> int:
         "p50_ms": closed["p50_ms"],
         "p99_ms": closed["p99_ms"],
         "n_chips": n_chips,
+        # Per-chip breakdown (ISSUE 7): the aggregate divided over the
+        # chips the run occupied, next to the per-replica dispatch counts
+        # in `parallel` so a starved chip is visible in the headline JSON.
+        "per_chip_img_s": round(value / n_chips, 1),
+        "parallel": parallel_info,
         "backend": backend,
         "errors": closed["n_err"],
         "mode": mode,
@@ -562,7 +609,12 @@ def main() -> int:
         "miss_pass_hit_rate": r["miss_hit_rate"],
         "cache_hit_rate": (r["hit"] or {}).get("cache_hit_rate"),
         # Measured fresh THIS run (subprocess probe; null if skipped/failed).
+        # chip_compute_img_s is ONE chip's compute rate; the aggregate
+        # multiplies it over every chip the serving path occupies — the
+        # ceiling an 8-chip run is honestly measured against (ISSUE 7).
         "chip_compute_img_s": chip.get("img_s"),
+        "aggregate_chip_img_s": (round(chip["img_s"] * n_chips, 1)
+                                 if chip.get("img_s") else None),
         "chip_ms_per_batch": chip.get("ms_per_batch"),
         # Roofline attribution (ISSUE 6, docs/PERFORMANCE.md "Reading the
         # roofline"): per-bucket raw-executable ms vs wire ms, per-phase
@@ -572,7 +624,7 @@ def main() -> int:
         "roofline": _rl.build_roofline(
             state.metrics.summary()["latency"], "resnet50", buckets,
             raw_by_bucket, best_link, img_bytes,
-            chip.get("img_s"), value),
+            chip.get("img_s"), value, n_chips=n_chips),
     }
     if r["hit"]:
         line["hit_heavy"] = r["hit"]

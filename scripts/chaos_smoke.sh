@@ -32,4 +32,7 @@ scripts/cache_smoke.sh
 echo "== roofline smoke (variant registry / zero recompiles / compute split) =="
 scripts/roofline_smoke.sh
 
+echo "== multichip smoke (8 replicas all serving / sharded mesh / reload mid-load) =="
+scripts/multichip_smoke.sh
+
 echo "chaos smoke OK"

@@ -1,0 +1,170 @@
+#!/usr/bin/env bash
+# Multichip smoke (ISSUE 7): a REAL server on 8 forced host devices proving
+# the multi-chip serving path end to end on CPU CI:
+#   1. replica-per-chip: the [parallel] block overrides the model onto 8
+#      single-device replicas and under sustained load EVERY replica's
+#      replica_batches_total moves — no starved chips, zero request errors;
+#   2. steady state recompiles NOTHING: the runtime_compiles_total delta
+#      across warm load PLUS a :reload landing MID-LOAD is exactly 0, and
+#      the reload answers 200 while every concurrent request succeeds
+#      (version-atomic across replicas — tests/test_multichip.py proves
+#      the per-response version discipline; this proves it live);
+#   3. sharded-batch: a second server serves one executable over the whole
+#      8-device mesh (sharded@d8), zero errors, per-chip share reported.
+# Run by CI next to the chaos/reload/pipeline/cache/roofline drills; see
+# docs/PERFORMANCE.md "Serving on the mesh".
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# 8 fake host devices (the standard JAX trick the test suite also uses);
+# keep any other XLA_FLAGS the environment set.
+case "${XLA_FLAGS:-}" in
+  *xla_force_host_platform_device_count*) ;;
+  *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+esac
+# Race-detection pass rides along (docs/ANALYSIS.md): replica dispatch,
+# publish/rollback, and the staging pools all run under witnessed locks.
+export TPUSERVE_LOCK_WITNESS=1
+
+python - <<'EOF'
+import asyncio
+
+import aiohttp
+from aiohttp import web
+
+from tpuserve.bench.loadgen import run_load, synthetic_pool
+from tpuserve.config import ModelConfig, ParallelConfig, ServerConfig
+from tpuserve.server import ServerState, make_app
+
+NPY = "application/x-npy"
+N = 8
+
+
+def make_cfg(mode: str) -> ServerConfig:
+    return ServerConfig(
+        decode_threads=2,
+        startup_canary=False,
+        # The override is the point: the model says "single", the
+        # [parallel] block puts the deployment on the mesh.
+        parallel=ParallelConfig(mode=mode),
+        models=[ModelConfig(
+            name="toy", family="toy",
+            batch_buckets=[1, 2] if mode == "replica" else [8, 16],
+            deadline_ms=2.0, dtype="float32", num_classes=10,
+            parallelism="single", request_timeout_ms=10_000.0,
+            wire_size=8, max_inflight=2,
+        )],
+    )
+
+
+async def scrape(base: str, session) -> tuple[dict, dict]:
+    async with session.get(f"{base}/metrics") as r:
+        text = await r.text()
+    metrics = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        k, v = line.rsplit(" ", 1)
+        try:
+            metrics[k] = float(v)
+        except ValueError:
+            pass
+    async with session.get(f"{base}/stats") as r:
+        stats = await r.json()
+    return metrics, stats
+
+
+async def serve(cfg):
+    state = ServerState(cfg)
+    state.build()
+    runner = web.AppRunner(make_app(state), access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return state, runner, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+
+async def replica_leg() -> None:
+    state, runner, base = await serve(make_cfg("replica"))
+    pool = synthetic_pool("npy", 32, edge=8)
+    url = f"{base}/v1/models/toy:classify"
+    try:
+        rt = state.runtimes["toy"]
+        assert rt.mode == "replica" and rt.n_replicas == N, rt.describe()
+
+        # Warm load, then the measured window the compile delta spans.
+        res = await run_load(url, pool, NPY, duration_s=2.0, warmup_s=0.5,
+                             concurrency=4 * N)
+        assert res.n_err == 0 and res.n_ok > 0, res.summary()
+        async with aiohttp.ClientSession() as s:
+            m0, _ = await scrape(base, s)
+
+            # Reload lands MID-LOAD: version-atomic publish across all 8
+            # replicas with zero request errors and zero recompiles.
+            async def reload_midway():
+                await asyncio.sleep(0.8)
+                async with s.post(f"{base}/admin/models/toy:reload") as r:
+                    assert r.status == 200, await r.text()
+                    return await r.json()
+
+            res2, info = await asyncio.gather(
+                run_load(url, pool, NPY, duration_s=2.5, warmup_s=0.0,
+                         concurrency=4 * N),
+                reload_midway())
+            assert res2.n_err == 0 and res2.n_ok > 0, res2.summary()
+            assert info["version"] == 2, info
+            m1, stats = await scrape(base, s)
+
+        key = 'runtime_compiles_total{model="toy"}'
+        assert m0.get(key, 0) > 0, f"no compiles recorded at startup: {m0}"
+        delta = m1.get(key, 0) - m0.get(key, 0)
+        assert delta == 0, f"steady state recompiled: delta={delta}"
+
+        # EVERY replica served batches — a zero row is a starved chip.
+        per_rep = [m1.get(
+            f'replica_batches_total{{model="toy",replica="{i}"}}', 0.0)
+            for i in range(N)]
+        assert all(v > 0 for v in per_rep), f"starved replica(s): {per_rep}"
+
+        par = stats["parallel"]["toy"]
+        assert par["signature"] == f"replica@{N}", par
+        assert par["n_chips"] == N and len(par["replica_batches_total"]) == N
+        rows = stats["pipeline"]["models"]["toy"]["per_replica"]
+        assert len(rows) == N and all("occupancy" in r for r in rows)
+        print(f"multichip replica leg OK: {res2.throughput:.1f}/s over "
+              f"{N} replicas, per-replica batches {per_rep}, "
+              f"compile delta 0, reload v{info['version']} mid-load")
+    finally:
+        await runner.cleanup()
+
+
+async def sharded_leg() -> None:
+    state, runner, base = await serve(make_cfg("sharded"))
+    pool = synthetic_pool("npy", 32, edge=8)
+    url = f"{base}/v1/models/toy:classify"
+    try:
+        rt = state.runtimes["toy"]
+        assert rt.mode == "sharded" and rt.n_chips == N, rt.describe()
+        assert rt.parallel_signature == f"sharded@d{N}"
+        res = await run_load(url, pool, NPY, duration_s=2.0, warmup_s=0.5,
+                             concurrency=4 * N)
+        assert res.n_err == 0 and res.n_ok > 0, res.summary()
+        async with aiohttp.ClientSession() as s:
+            _, stats = await scrape(base, s)
+        par = stats["parallel"]["toy"]
+        assert par["signature"] == f"sharded@d{N}", par
+        assert par["n_chips"] == N and par["batches_per_chip"] > 0, par
+        print(f"multichip sharded leg OK: {res.throughput:.1f}/s on "
+              f"sharded@d{N}, {par['batches_per_chip']} batches/chip")
+    finally:
+        await runner.cleanup()
+
+
+async def main() -> None:
+    await replica_leg()
+    await sharded_leg()
+    print("multichip smoke OK")
+
+
+asyncio.run(main())
+EOF

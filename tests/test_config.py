@@ -250,3 +250,37 @@ def test_cache_and_adaptive_defaults_and_validation():
         AdaptiveConfig(decrease=1.5)
     with pytest.raises(ValueError, match="ewma_alpha"):
         AdaptiveConfig(ewma_alpha=0.0)
+
+
+def test_parallel_block(tmp_path):
+    """[parallel] (ISSUE 7): the multi-chip serving plan parses from TOML
+    and from dot-path overrides; invalid modes reject at construction."""
+    from tpuserve.config import ParallelConfig
+
+    p = tmp_path / "serve.toml"
+    p.write_text(
+        """
+[parallel]
+mode = "replica"
+n_chips = 4
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.parallel.mode == "replica"
+    assert cfg.parallel.n_chips == 4
+    assert cfg.parallel.data == 0
+
+    cfg = load_config(str(p), overrides=["parallel.mode=sharded",
+                                         "parallel.data=8"])
+    assert cfg.parallel.mode == "sharded" and cfg.parallel.data == 8
+
+    # Defaults: per-model parallelism rules, all chips.
+    assert ServerConfig().parallel.mode == ""
+    with pytest.raises(ValueError, match="parallel.mode"):
+        ParallelConfig(mode="pipeline")
+    with pytest.raises(ValueError, match="n_chips"):
+        ParallelConfig(data=-1)

@@ -167,3 +167,21 @@ def test_closed_loop_cycles_distinct_pool(loop):
         assert {s.decode() for s in seen} == {f"payload-{i}" for i in range(4)}
 
     loop.run_until_complete(go())
+
+
+def test_closed_loop_concurrency_scales_with_chips():
+    """ISSUE 7 satellite: loadgen connection count derives from the chip
+    count — an 8-chip mesh driven with a single-chip connection count is
+    demand-starved and the bench under-reports by design."""
+    from tpuserve.bench.loadgen import closed_loop_concurrency
+
+    # Single chip: identical to the historical formula min(384, max(32, 3*top)).
+    assert closed_loop_concurrency([8, 32], 1) == 96
+    assert closed_loop_concurrency([128], 1) == 384  # per-chip cap
+    assert closed_loop_concurrency([1, 2], 1) == 32  # floor
+    # 8 chips: 8x the demand, cap scales too.
+    assert closed_loop_concurrency([8, 32], 8) == 8 * 96
+    assert closed_loop_concurrency([128], 8) == 3 * 128 * 8
+    assert closed_loop_concurrency([128], 8) <= 384 * 8
+    # Degenerate inputs stay sane.
+    assert closed_loop_concurrency([], 0) == 32

@@ -159,6 +159,7 @@ class ModelBatcher:
         self._dispatch_tasks: set[asyncio.Task] = set()
         self._inflight: asyncio.Semaphore | None = None
         self._staging: list[SlotPool] = []
+        self._g_replica_inflight: list[Any] = []
         self.arena: AssemblyArena | None = None
         self.depth = 0
         self._admission_cap = 0
@@ -205,7 +206,16 @@ class ModelBatcher:
                 self.runtime.h2d_sync = pcfg.h2d_sync
             self.depth = max(1, pcfg.depth or self.cfg.max_inflight)
             self._staging = [SlotPool(self.depth) for _ in range(n_rep)]
+            # Replica-aware admission: depth-k batches per DEVICE section
+            # plus the assembly ramp — with 8 replicas the pipeline admits
+            # 8x the single-chip batch count, which is what keeps every
+            # chip's staging slots full instead of one chip's (ISSUE 7).
             self._admission_cap = self.depth * n_rep + pcfg.assemble_ahead
+            # Per-chip occupancy gauges (docs/PERFORMANCE.md "Serving on
+            # the mesh"), prebound once per replica.
+            self._g_replica_inflight = [
+                self.metrics.replica_inflight_gauge(self.cfg.name, i)
+                for i in range(n_rep)]
             arena_slots = pcfg.arena_slots or (self.depth + pcfg.assemble_ahead)
             self.arena = (AssemblyArena(self.model, arena_slots, self.metrics)
                           if self._use_arena else None)
@@ -545,20 +555,28 @@ class ModelBatcher:
 
     async def _acquire_staging(self, reqs: list[_Request]) -> tuple[int | None, int | None]:
         """Pick a replica and take one of its depth-k staging slots, bounded
-        by the earliest per-request deadline. Tries every replica's pool
-        before waiting (a free slot anywhere beats queueing on the
-        round-robin pick). Returns (replica, slot), or (None, None) when
-        every request expired while waiting — their futures already carry
-        DeadlineExceeded (fast 504)."""
+        by the earliest per-request deadline. The first choice is the
+        runtime's least-loaded pick (fed each pool's live occupancy); when
+        that pool is exhausted the fallback scans the REMAINING pools in
+        ascending-occupancy order — the old fixed index-order scan
+        systematically filled low-index replicas first and starved
+        high-index chips under bursty load (ISSUE 7 satellite). Returns
+        (replica, slot), or (None, None) when every request expired while
+        waiting — their futures already carry DeadlineExceeded (fast
+        504)."""
         live = [r for r in reqs if not r.future.done()]
         n = len(self._staging)
         while True:
-            first = self.runtime.pick_replica() if n > 1 else 0
-            for k in range(n):
-                i = (first + k) % n
+            loads = [p.in_use for p in self._staging]
+            first = self.runtime.pick_replica(loads) if n > 1 else 0
+            slot = self._staging[first].try_acquire()
+            if slot is not None:
+                return self._staged(first), slot
+            for i in sorted((j for j in range(n) if j != first),
+                            key=lambda j: (loads[j], (j - first) % n)):
                 slot = self._staging[i].try_acquire()
                 if slot is not None:
-                    return i, slot
+                    return self._staged(i), slot
             live = self._expire_dead(live, adjust_pending=False)
             if not live:
                 return None, None
@@ -567,9 +585,23 @@ class ModelBatcher:
             timeout = (None if earliest is None
                        else max(0.0, earliest - time.perf_counter()))
             try:
-                return first, await self._staging[first].acquire(timeout)
+                slot = await self._staging[first].acquire(timeout)
+                return self._staged(first), slot
             except asyncio.TimeoutError:
                 continue
+
+    def _staged(self, replica: int) -> int:
+        """Record a staging acquire on the replica's occupancy gauge."""
+        if self._g_replica_inflight:
+            self._g_replica_inflight[replica].set(
+                self._staging[replica].in_use)
+        return replica
+
+    def _release_staging(self, replica: int, slot: int) -> None:
+        self._staging[replica].release(slot)
+        if self._g_replica_inflight:
+            self._g_replica_inflight[replica].set(
+                self._staging[replica].in_use)
 
     async def _execute(self, reqs: list[_Request], group: Hashable,
                        released: list[bool]) -> None:
@@ -650,7 +682,7 @@ class ModelBatcher:
                     t3 = time.perf_counter()
                     self._h_phase["compute"].observe((t3 - t2) * 1e3)
                 finally:
-                    self._staging[replica].release(slot)
+                    self._release_staging(replica, slot)
         finally:
             if lease is not None:
                 # Safe only now: the fetch completing proves the device is
@@ -736,4 +768,18 @@ class ModelBatcher:
             out["staging_in_use"] = [p.in_use for p in self._staging]
             out["arena"] = (self.arena.stats()
                             if self.arena is not None else None)
+            # Per-chip serving attribution (ISSUE 7): dispatch count and
+            # live device-section occupancy per replica, so an operator
+            # (or the multichip smoke) sees a starved chip as a row of
+            # zeros instead of a vaguely-low aggregate.
+            batches = (self.runtime.replica_batches()
+                       if hasattr(self.runtime, "replica_batches")
+                       else [None] * len(self._staging))
+            out["per_replica"] = [
+                {"replica": i,
+                 "batches_total": batches[i],
+                 "staging_in_use": p.in_use,
+                 "occupancy": round(p.in_use / self.depth, 3)
+                 if self.depth else 0.0}
+                for i, p in enumerate(self._staging)]
         return out

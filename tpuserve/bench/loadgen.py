@@ -79,6 +79,21 @@ class LoadResult:
         return out
 
 
+def closed_loop_concurrency(buckets: list[int], n_chips: int = 1,
+                            per_chip_cap: int = 384) -> int:
+    """Loadgen connection count for a closed-loop bench run.
+
+    Per chip, keep ~3 top-bucket batches of demand in flight (one
+    computing, one in transfer, one assembling — the pipeline's natural
+    occupancy), floored at 32 connections and capped at ``per_chip_cap``.
+    Scaling by ``n_chips`` is the point (ISSUE 7 satellite): a closed loop
+    sized for one chip offers exactly one chip's worth of demand, so an
+    8-chip mesh idles 7 chips and the bench under-reports by design."""
+    n = max(1, n_chips)
+    top = max(buckets) if buckets else 0
+    return min(per_chip_cap * n, max(32, 3 * top * n))
+
+
 def synthetic_image_npy(edge: int = 256, seed: int = 0) -> bytes:
     rng = np.random.default_rng(seed)
     arr = rng.integers(0, 255, (edge, edge, 3), dtype=np.uint8)

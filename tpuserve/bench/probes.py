@@ -51,6 +51,30 @@ H2D_PROBE_SRC = textwrap.dedent("""
 """)
 
 
+def probe_device_count(timeout: float = 300.0, cwd: str | None = None) -> int:
+    """Visible accelerator count, measured in a fresh subprocess.
+
+    The bench needs the chip count BEFORE it shapes load (connection count,
+    offered rate scale with it — a v5e-8 driven with a single-chip load
+    profile is demand-starved and under-reports by design), but touching
+    ``jax.devices()`` in the calling process would take the accelerator
+    before the link/chip probes run in their own virgin subprocesses. Same
+    fresh-subprocess discipline as every probe here; returns 1 on failure
+    (the single-chip shape is the safe under-estimate)."""
+    src = ("import json, jax; "
+           "print(json.dumps({'n': len(jax.devices())}))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", src],
+                              capture_output=True, text=True,
+                              timeout=timeout, cwd=cwd)
+        if proc.returncode != 0:
+            return 1
+        return max(1, int(json.loads(
+            proc.stdout.strip().splitlines()[-1])["n"]))
+    except Exception:  # noqa: BLE001 — probes must never kill the bench
+        return 1
+
+
 def measure_h2d_mbps(mode: str = "virgin", timeout: float = 600.0,
                      cwd: str | None = None,
                      chunk_bytes: int = 8 << 20) -> dict:

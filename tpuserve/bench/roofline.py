@@ -115,14 +115,21 @@ def build_roofline(latency_summary: dict, model: str, buckets: list[int],
                    raw_ms_by_bucket: dict[int, float | None],
                    link_mbps: float, img_bytes: int,
                    chip_img_s: float | None,
-                   value_img_s: float | None) -> dict:
+                   value_img_s: float | None,
+                   n_chips: int = 1) -> dict:
     """The bench/``/stats`` ``roofline`` block for one model.
 
     ``raw_ms_by_bucket`` maps batch size -> raw-executable ms/batch (None
     where unprobed). Ceilings: the top bucket's wire time for h2d, its raw
     executable time for compute (the top bucket is what a saturated closed
     loop overwhelmingly serves; per-bucket numbers ship alongside so the
-    reader can re-ratio for other fills)."""
+    reader can re-ratio for other fills).
+
+    ``chip_img_s`` is the SINGLE-chip compute probe; with ``n_chips`` > 1
+    the serving path has n_chips of those, so ``pct_of_chip_ceiling`` is
+    taken against the aggregate (chip_img_s x n_chips) — an 8-chip run
+    reporting 100% of one chip's ceiling is at 12.5% of the hardware it
+    holds, and the block must say so (ISSUE 7)."""
     top = max(buckets) if buckets else None
     per_bucket: dict[str, dict] = {}
     for b in sorted(buckets):
@@ -161,5 +168,10 @@ def build_roofline(latency_summary: dict, model: str, buckets: list[int],
         "binding_phase": binding,
     }
     if chip_img_s and value_img_s is not None:
-        out["pct_of_chip_ceiling"] = round(100.0 * value_img_s / chip_img_s, 1)
+        n = max(1, n_chips)
+        aggregate = chip_img_s * n
+        out["chip_ceiling_img_s"] = round(chip_img_s, 1)
+        out["aggregate_chip_ceiling_img_s"] = round(aggregate, 1)
+        out["n_chips"] = n
+        out["pct_of_chip_ceiling"] = round(100.0 * value_img_s / aggregate, 1)
     return out

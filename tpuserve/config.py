@@ -234,6 +234,50 @@ class AdaptiveConfig:
 
 
 @dataclass
+class ParallelConfig:
+    """Multi-chip serving plan (``[parallel]`` TOML; docs/PERFORMANCE.md
+    "Serving on the mesh").
+
+    Server-wide selection of how the serving path uses the device mesh.
+    Per-model ``parallelism`` remains the fine-grained knob; this block
+    exists so one line flips a whole deployment between the two multi-chip
+    modes (AlpaServe, PAPERS.md P5: placement is a throughput/latency
+    lever, not a memory trick):
+
+    - ``mode = "replica"`` — N independent single-device runtime replicas,
+      params replicated per chip, the batcher keeping every replica's
+      depth-k staging slots full via least-loaded dispatch.
+    - ``mode = "sharded"`` — ONE executable over the whole mesh, the batch
+      sharded on the data axis (``parallel.mesh.batch_sharding``).
+    - ``mode = "single"`` — first device only (dev mode).
+    - ``mode = ""`` (default) — every model keeps its own ``parallelism``.
+
+    A non-empty mode overrides EVERY configured model (including
+    ``pipeline`` models — the override is deliberate and total, so a
+    drill can flatten a fleet to one layout with one override flag)."""
+
+    # "" = respect per-model `parallelism`; "replica" / "sharded" /
+    # "single" override every model's mode at build time.
+    mode: str = ""
+    # Devices the serving path uses; 0 = every visible device. Lets one
+    # host carve chips between serving and background work, and makes
+    # CPU-CI runs (8 forced host devices) byte-for-byte reproducible.
+    n_chips: int = 0
+    # Sharded mode: data-axis size; 0 derives it from the device count and
+    # the model's tp/sp axes. Setting `data` with n_chips = 0 sizes the
+    # mesh to exactly data * tp * sp devices.
+    data: int = 0
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("", "replica", "sharded", "single"):
+            raise ValueError(
+                f"parallel.mode must be one of '', 'replica', 'sharded', "
+                f"'single'; got {self.mode!r} (pipeline is per-model only)")
+        if self.n_chips < 0 or self.data < 0:
+            raise ValueError("parallel.n_chips/data must be >= 0")
+
+
+@dataclass
 class ModelConfig:
     """Per-model serving configuration."""
 
@@ -377,6 +421,9 @@ class ServerConfig:
     port: int = 8000
     # Multi-host runtime init; defaults to single-host (disabled).
     distributed: DistributedConfig = field(default_factory=DistributedConfig)
+    # Multi-chip serving plan: replica-per-chip vs sharded-batch over the
+    # local mesh (docs/PERFORMANCE.md "Serving on the mesh").
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
     models: list[ModelConfig] = field(default_factory=list)
     # Host-side decode threadpool size.
     decode_threads: int = 8
@@ -463,6 +510,7 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
+    parallel_dict = raw.pop("parallel", None)
     faults_dict = raw.pop("faults", None)
     lifecycle_dict = raw.pop("lifecycle", None)
     pipeline_dict = raw.pop("pipeline", None)
@@ -472,6 +520,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
     if dist_dict is not None:
         cfg.distributed = _build(DistributedConfig, dist_dict)
+    if parallel_dict is not None:
+        cfg.parallel = _build(ParallelConfig, parallel_dict)
     if lifecycle_dict is not None:
         cfg.lifecycle = _build(LifecycleConfig, lifecycle_dict)
     if pipeline_dict is not None:

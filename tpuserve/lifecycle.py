@@ -223,23 +223,35 @@ class ModelLifecycle:
             f"reload rejected at {stage} gate: {err}", stage=stage) from err
 
     def _staged_canary_sync(self, staged: list[Any]) -> None:
-        """Run the model's canary item through the real compiled executable
+        """Run the model's canary item through the real compiled executables
         against the STAGED tree (params_override): the candidate proves
         itself on device before one request can reach it. Blocking D2H —
-        runs in the default executor."""
+        runs in the default executor.
+
+        Multi-chip (ISSUE 7): the canary runs on EVERY replica — staging
+        device_puts one candidate copy per mesh, and a copy corrupted on
+        replica 5 alone must fail the gate, not serve an eighth of the
+        traffic. Dispatches go out async first so the replica loads
+        overlap; one fetch per replica then proves each. Sharded mode has
+        one mesh, so this degenerates to the single canary it always was."""
         item = self.model.canary_item()
         bucket = self.model.bucket_for(1, group=self.model.group_key(item))
         host_batch = self.model.assemble([item], bucket)
-        out = self.runtime.fetch(self.runtime.run(
-            bucket, host_batch, replica=0, params_override=staged))
-        bad = [k for k, a in _np_leaves(out)
-               if a.dtype.kind == "f" and not np.isfinite(a).all()]
-        if bad:
-            raise ValueError("staged canary produced non-finite outputs "
-                             f"in {bad}")
-        results = self.model.host_postprocess(out, 1)
-        if not results:
-            raise ValueError("staged canary produced no result")
+        n = max(1, int(getattr(self.runtime, "n_replicas", 1)))
+        pending = [self.runtime.run(bucket, host_batch, replica=i,
+                                    params_override=staged)
+                   for i in range(n)]
+        for i, dev_out in enumerate(pending):
+            out = self.runtime.fetch(dev_out)
+            bad = [k for k, a in _np_leaves(out)
+                   if a.dtype.kind == "f" and not np.isfinite(a).all()]
+            if bad:
+                raise ValueError("staged canary produced non-finite outputs "
+                                 f"in {bad} on replica {i}")
+            results = self.model.host_postprocess(out, 1)
+            if not results:
+                raise ValueError(
+                    f"staged canary produced no result on replica {i}")
 
     async def _rollback_locked(self, reason: str) -> dict:
         self._cancel_soak()

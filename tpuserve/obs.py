@@ -232,6 +232,26 @@ class Metrics:
         call this per request."""
         return self.counter(f"cache_{event}_total{{model={model}}}")
 
+    def replica_batches_counter(self, model: str, replica: int) -> Counter:
+        """replica_batches_total{model=,replica=}: batches dispatched on one
+        runtime replica (tpuserve.runtime.dispatch). Per-chip attribution
+        for multi-chip serving (docs/PERFORMANCE.md "Serving on the
+        mesh"): every replica nonzero under load is the proof the batcher
+        keeps the whole mesh busy; a flat-zero replica is a starved chip.
+        Prebound at runtime construction — never call per batch."""
+        return self.counter(
+            f"replica_batches_total{{model={model},replica={replica}}}")
+
+    def replica_inflight_gauge(self, model: str, replica: int) -> Gauge:
+        """replica_inflight{model=,replica=}: batches currently occupying
+        one replica's depth-k device-section staging slots
+        (tpuserve.batcher). Occupancy at depth on every replica = the mesh
+        is compute-bound; occupancy pinned at 0 on some replicas = the
+        load (or the replica pick) is starving chips. Prebound at batcher
+        start — never call per batch."""
+        return self.gauge(
+            f"replica_inflight{{model={model},replica={replica}}}")
+
     def set_model_version(self, model: str, version: int) -> None:
         """model_version{model=}: the live weight-tree version number
         (tpuserve.lifecycle). A sawtooth on a dashboard = publish followed

@@ -27,6 +27,8 @@ from tpuserve.parallel.mesh import (  # noqa: F401
     batch_sharding,
     replicated_sharding,
     local_device_count,
+    plan_for,
+    select_devices,
 )
 from tpuserve.parallel.pipeline import (  # noqa: F401
     make_stage_mesh,

@@ -106,3 +106,32 @@ def pad_batch_to_mesh(batch_size: int, mesh: Mesh) -> int:
     """Smallest batch >= batch_size divisible by the data-axis size."""
     d = mesh.shape[DATA_AXIS]
     return ((batch_size + d - 1) // d) * d
+
+
+def select_devices(n_chips: int = 0, devices: list | None = None) -> list:
+    """The device set a ``[parallel]`` plan serves on.
+
+    ``n_chips = 0`` takes every visible device; a positive count takes the
+    first ``n_chips`` (stable ``jax.devices()`` order, so replica indices
+    in metrics map to the same physical chips across restarts). Asking for
+    more devices than exist is a configuration error, not a silent clamp —
+    a deployment that believes it serves on 8 chips must never quietly run
+    on 1 (SURVEY.md §7 hard part 7: never hard-code counts, never lie
+    about them either)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_chips <= 0:
+        return devs
+    if n_chips > len(devs):
+        raise ValueError(
+            f"parallel.n_chips={n_chips} but only {len(devs)} device(s) "
+            "visible")
+    return devs[:n_chips]
+
+
+def plan_for(parallel: "object", tp: int = 1, sp: int = 1) -> MeshPlan:
+    """MeshPlan for a sharded-batch serving mesh from a ``[parallel]``
+    block (config.ParallelConfig): an explicit ``data`` pins the data-axis
+    size, otherwise it derives from whatever device count ``select_devices``
+    returned (dp = -1)."""
+    data = int(getattr(parallel, "data", 0) or 0)
+    return MeshPlan(dp=data if data > 0 else -1, tp=tp, sp=sp)

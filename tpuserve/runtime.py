@@ -39,11 +39,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpuserve.config import ModelConfig, ServerConfig
+from tpuserve.config import ModelConfig, ParallelConfig, ServerConfig
 from tpuserve.models.base import ServingModel
 from tpuserve.obs import Metrics
 from tpuserve.parallel import make_mesh, match_partition_rules
-from tpuserve.parallel.mesh import MeshPlan
+from tpuserve.parallel.mesh import MeshPlan, plan_for, select_devices
 from tpuserve.parallel.partition import specs_to_shardings
 from tpuserve.utils.locks import new_lock
 
@@ -166,12 +166,19 @@ class ModelRuntime:
     """Owns params-on-device and the compiled executable set for one model."""
 
     def __init__(self, model: ServingModel, mesh: Mesh | None = None,
-                 metrics: Metrics | None = None) -> None:
+                 metrics: Metrics | None = None,
+                 parallel: ParallelConfig | None = None) -> None:
         self.model = model
         self.cfg: ModelConfig = model.cfg
         # A private registry when the caller has none (direct construction in
         # tests/probes): the counters still work, they just aren't scraped.
         self.metrics = metrics if metrics is not None else Metrics()
+        # Server-wide multi-chip plan ([parallel] block): bounds the device
+        # set and sizes the sharded data axis. The MODE override happens at
+        # the config level (ServerState.build rewrites cfg.parallelism
+        # before the model is even built, so family-level mode checks see
+        # it); by the time a runtime exists, cfg.parallelism is the truth.
+        self.pcfg = parallel if parallel is not None else ParallelConfig()
         self.mode = self.cfg.parallelism
         if self.mode not in ("sharded", "replica", "single", "pipeline"):
             raise ValueError(f"unknown parallelism mode {self.mode!r}")
@@ -185,11 +192,20 @@ class ModelRuntime:
                 "int8-native kernel sites; use quantize='int8' "
                 "(weight-only) instead")
 
+        # Device set the [parallel] plan serves on: every visible device by
+        # default, the first n_chips when bounded. `data` alone sizes a
+        # sharded mesh to exactly data*tp*sp chips.
+        n_chips = self.pcfg.n_chips
+        if not n_chips and self.pcfg.data and self.mode == "sharded":
+            n_chips = self.pcfg.data * self.cfg.tp * self.cfg.sp
+        devs = select_devices(n_chips)
         if self.mode == "replica":
             # One 1-device mesh per device; params replicated per device.
-            self.meshes = [make_mesh(MeshPlan(), devices=[d]) for d in jax.devices()]
+            # Each replica is an independent failure/serving domain: the
+            # batcher keeps a depth-k staging-slot pool per entry here.
+            self.meshes = [make_mesh(MeshPlan(), devices=[d]) for d in devs]
         elif self.mode == "single":
-            self.meshes = [make_mesh(MeshPlan(), devices=[jax.devices()[0]])]
+            self.meshes = [make_mesh(MeshPlan(), devices=[devs[0]])]
         elif self.mode == "pipeline":
             # GPipe stages over a ("stage",) mesh: each device holds 1/S of
             # the layer stack's params (tpuserve.parallel.pipeline). The
@@ -206,11 +222,13 @@ class ModelRuntime:
                     "modes yet; drop one of the two")
             from tpuserve.parallel.pipeline import make_stage_mesh
 
-            n = self.cfg.pp or len(jax.devices())
+            n = self.cfg.pp or len(devs)
             self.meshes = [make_stage_mesh(n)]
         else:
             self.meshes = [mesh if mesh is not None
-                           else make_mesh(MeshPlan(tp=self.cfg.tp, sp=self.cfg.sp))]
+                           else make_mesh(plan_for(self.pcfg, tp=self.cfg.tp,
+                                                   sp=self.cfg.sp),
+                                          devices=devs)]
         # Mesh-aware models (e.g. BERT ring attention) rebuild their forward
         # around the serving mesh; must precede param load and compilation.
         model.bind_mesh(self.meshes[0])
@@ -255,6 +273,15 @@ class ModelRuntime:
         # Batches dispatched per specialized variant, prebound at compile
         # time (one locked inc per batch, not per request).
         self._c_variant_batches: dict[tuple, Any] = {}
+        # Per-chip dispatch attribution (Clockwork P3: predictability needs
+        # per-device accounting shipped WITH the parallel placement, not
+        # after it): one prebound counter per replica, ticked in dispatch().
+        # In sharded mode there is one entry covering the whole mesh — the
+        # per-chip share is the aggregate divided by the data-axis size,
+        # which /stats' parallel block reports alongside.
+        self._c_replica_batches = [
+            self.metrics.replica_batches_counter(name, i)
+            for i in range(len(self.meshes))]
         # Versioned lifecycle (tpuserve.lifecycle): the live tree carries a
         # monotonically numbered version; publish() retains the previous tree
         # as last-known-good so rollback() is a pointer swap, not a reload.
@@ -361,11 +388,28 @@ class ModelRuntime:
                 qz.dequantize_tree_except(p, dtype, keep), batch)
         return self.model.forward
 
+    @property
+    def parallel_signature(self) -> str:
+        """The parallelism dimension of every VariantKey this runtime
+        compiles (ISSUE 7): the mode PLUS the device layout it was
+        specialized on, so an 8-chip sharded executable and a 1-chip one
+        are distinct registry entries (they are different XLA programs)
+        while staying one label on a dashboard. "single" stays bare — it
+        is the 1-chip degenerate case every prior test/bench name uses."""
+        if self.mode == "sharded":
+            return f"sharded@d{self.meshes[0].shape['data']}"
+        if self.mode == "replica":
+            return f"replica@{len(self.meshes)}"
+        if self.mode == "pipeline":
+            return f"pipeline@{dict(self.meshes[0].shape).get('stage', 1)}"
+        return self.mode
+
     def variant_key(self, bucket: tuple) -> VariantKey:
         """The ACTIVE variant identity for a bucket: what this runtime's
         config specializes its executables on."""
         return VariantKey(bucket=tuple(bucket), dtype=self.cfg.dtype,
-                          quantize=self.cfg.quantize, parallelism=self.mode)
+                          quantize=self.cfg.quantize,
+                          parallelism=self.parallel_signature)
 
     def compile_all(self, pool: cf.ThreadPoolExecutor | None = None) -> None:
         """AOT-compile every bucket (in parallel when a pool is given)."""
@@ -480,12 +524,38 @@ class ModelRuntime:
         staging-slot pool per replica)."""
         return len(self.meshes)
 
-    def pick_replica(self) -> int:
-        if len(self.meshes) == 1:
+    @property
+    def n_chips(self) -> int:
+        """Physical devices the serving path occupies: replica meshes are
+        disjoint single-device meshes (sum = chip count), a sharded mesh is
+        one mesh spanning them all."""
+        return sum(m.size for m in self.meshes)
+
+    def replica_batches(self) -> list[float]:
+        """Current per-replica dispatch counts (replica_batches_total),
+        in replica order — the /stats parallel block and the multichip
+        smoke read these to prove every chip actually serves."""
+        return [c.value for c in self._c_replica_batches]
+
+    def pick_replica(self, loads: "list[int] | None" = None) -> int:
+        """First-choice replica for the next batch.
+
+        With ``loads`` (the batcher passes each replica's staging-slot
+        occupancy) this is least-loaded: the emptiest device section gets
+        the work, so a slow batch on one chip never starves the other
+        seven of their depth-k pipelines. Ties break on a rotating
+        round-robin cursor so equal-load replicas still alternate instead
+        of replica 0 absorbing every cold start. Without ``loads`` it is
+        plain round-robin (prewarm, canaries, direct run() callers)."""
+        n = len(self.meshes)
+        if n == 1:
             return 0
         with self._rr_lock:
-            self._rr = (self._rr + 1) % len(self.meshes)
-            return self._rr
+            self._rr = (self._rr + 1) % n
+            start = self._rr
+        if not loads:
+            return start
+        return min(range(n), key=lambda i: (loads[i], (i - start) % n))
 
     def h2d(self, bucket: tuple, host_batch: Any, replica: int = 0) -> Any:
         """Transfer stage: ONE batched device_put of the whole host pytree
@@ -520,6 +590,7 @@ class ModelRuntime:
         c = self._c_variant_batches.get(bucket)
         if c is not None:
             c.inc()
+        self._c_replica_batches[replica].inc()
         params = (params_override if params_override is not None
                   else self.params_per_mesh)
         return exe.compiled(params[replica], dev_batch)
@@ -679,7 +750,16 @@ class ModelRuntime:
 
     def publish(self, staged: list[Any]) -> dict:
         """Atomically make a staged tree live as version N+1; the previous
-        tree is retained as last-known-good for rollback()."""
+        tree is retained as last-known-good for rollback().
+
+        Multi-chip atomicity (ISSUE 7): ``staged`` holds one tree PER MESH
+        (stage_params device_puts the candidate to every replica / the
+        whole sharded mesh before this is called), and the publication is
+        ONE list-reference assignment — so there is no instant at which
+        replica 3 serves version N+1 while replica 5 still serves N.
+        dispatch() snapshots the list once per batch; in-flight batches
+        finish on the version they captured, which is version-consistent
+        per batch by construction."""
         with self._reload_lock:
             self._prev_params = self.params_per_mesh
             self._prev_version = self.version
@@ -742,6 +822,8 @@ class ModelRuntime:
             "labels": self.cfg.labels,
             "options": dict(self.cfg.options),
             "replicas": len(self.meshes),
+            "n_chips": self.n_chips,
+            "parallel": self.parallel_signature,
             "mesh_shape": dict(self.meshes[0].shape),
             "buckets": [list(b) for b in sorted(self.executables)],
             # Specialized-variant registry: what is compiled-resident, with
@@ -754,8 +836,9 @@ class ModelRuntime:
 
 def build_runtime(model: ServingModel, mesh: Mesh | None = None,
                   pool: cf.ThreadPoolExecutor | None = None,
-                  metrics: Metrics | None = None) -> ModelRuntime:
-    rt = ModelRuntime(model, mesh, metrics=metrics)
+                  metrics: Metrics | None = None,
+                  parallel: ParallelConfig | None = None) -> ModelRuntime:
+    rt = ModelRuntime(model, mesh, metrics=metrics, parallel=parallel)
     rt.load_and_shard_params()
     rt.compile_all(pool)
     return rt

@@ -133,6 +133,20 @@ class ServerState:
         configure_jax(self.cfg)
         if self.cfg.profiler_port:
             jax.profiler.start_server(self.cfg.profiler_port)
+        # [parallel] mode override (docs/PERFORMANCE.md "Serving on the
+        # mesh"): applied at the CONFIG level, before the model is built,
+        # so family-level mode validation (e.g. BERT ring attention
+        # rejecting replica) and the model's own batch_spec see the real
+        # serving mode. Recycle-mode models keep their own parallelism —
+        # their runtimes live in worker processes with one device each.
+        if self.cfg.parallel.mode:
+            for mcfg in self.cfg.models:
+                if mcfg.session_mode != "recycle" \
+                        and mcfg.parallelism != self.cfg.parallel.mode:
+                    log.info("model %s: [parallel] mode overrides "
+                             "parallelism %r -> %r", mcfg.name,
+                             mcfg.parallelism, self.cfg.parallel.mode)
+                    mcfg.parallelism = self.cfg.parallel.mode
         compile_pool = cf.ThreadPoolExecutor(max_workers=4, thread_name_prefix="compile")
         try:
             for mcfg in self.cfg.models:
@@ -149,7 +163,8 @@ class ServerState:
                     rt.prewarm()
                 else:
                     rt = build_runtime(model, pool=compile_pool,
-                                       metrics=self.metrics)
+                                       metrics=self.metrics,
+                                       parallel=self.cfg.parallel)
                     if self.cfg.prewarm_executables:
                         rt.prewarm()
                     if self.cfg.roofline_probe_iters > 0:
@@ -331,6 +346,27 @@ class ServerState:
                 if split is not None:
                     row["compute_split"] = split
             out[name] = row
+        return out
+
+    def parallel_stats(self) -> dict:
+        """The /stats ``parallel`` block (docs/PERFORMANCE.md "Serving on
+        the mesh"): per model the live serving layout and per-chip dispatch
+        attribution — replica mode lists one count per chip; sharded mode
+        has one mesh-wide count, reported with its per-chip share."""
+        out: dict = {}
+        for name, rt in self.runtimes.items():
+            if not hasattr(rt, "parallel_signature"):
+                continue  # deferred pools own their devices out-of-process
+            batches = rt.replica_batches()
+            out[name] = {
+                "mode": rt.mode,
+                "signature": rt.parallel_signature,
+                "n_chips": rt.n_chips,
+                "replicas": rt.n_replicas,
+                "replica_batches_total": batches,
+                "batches_per_chip": round(sum(batches) / rt.n_chips, 2)
+                if rt.n_chips else 0.0,
+            }
         return out
 
     def shed_retry_after(self) -> int:
@@ -570,6 +606,11 @@ async def handle_stats(request: web.Request) -> web.Response:
         "stages": state.stages.stats(),
         "models": {n: b.pipeline_stats() for n, b in state.batchers.items()},
     }
+    # Multi-chip serving layout + per-chip dispatch attribution
+    # (docs/PERFORMANCE.md "Serving on the mesh").
+    parallel = state.parallel_stats()
+    if parallel:
+        out["parallel"] = parallel
     # Demand-shaping layer: per-model result-cache occupancy and the
     # hit/miss/coalesced/stale accounting (docs/PERFORMANCE.md).
     if state.caches:
