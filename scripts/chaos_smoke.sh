@@ -35,4 +35,7 @@ scripts/roofline_smoke.sh
 echo "== multichip smoke (8 replicas all serving / sharded mesh / reload mid-load) =="
 scripts/multichip_smoke.sh
 
+echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
+scripts/worker_drill.sh
+
 echo "chaos smoke OK"

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Kill-worker chaos drill (ISSUE 8): a REAL router + 2 worker processes
+# under closed-loop load; SIGKILL one worker mid-load and assert the
+# process-split's promises hold (docs/ROBUSTNESS.md "Process failure
+# domains"):
+#   1. availability >= 99% across the whole run, kill included (in-flight
+#      requests on the victim are retried onto the survivor);
+#   2. the supervisor respawns the victim within the backoff budget;
+#   3. zero torn/duplicate responses: a validator byte-compares every 200
+#      body against a pre-kill reference throughout.
+# Runs the real `python -m tpuserve chaos --drill worker_kill` CLI; wired
+# into chaos_smoke.sh and CI next to the reload/pipeline/cache drills.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): router, supervisor,
+# and both workers all run under witnessed locks.
+export TPUSERVE_LOCK_WITNESS=1
+
+CFG="$(mktemp /tmp/tpuserve_worker_drill.XXXXXX.toml)"
+OUT="$(mktemp /tmp/tpuserve_worker_drill.XXXXXX.json)"
+trap 'rm -f "$CFG" "$OUT"' EXIT
+
+cat > "$CFG" <<'EOF'
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+
+[router]
+enabled = true
+workers = 2
+retry_max = 2
+hedge_ms = 200.0
+health_interval_s = 0.2
+respawn_initial_s = 0.5
+respawn_max_s = 5.0
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+EOF
+
+python -m tpuserve chaos --config "$CFG" --drill worker_kill \
+    --duration 12 --warmup 1 --concurrency 8 --kill-after 1 \
+    --respawn-budget 90 --min-availability 0.99 | tee "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, sys
+
+s = json.load(open(sys.argv[1]))
+kill = s["kill"]
+integ = s["integrity"]
+assert s["availability"] >= 0.99, f"availability {s['availability']}"
+assert kill.get("respawn_s") is not None, f"no respawn within budget: {kill}"
+budget = s["router"]["respawn_backoff_initial_s"] + 60.0
+assert kill["respawn_s"] <= budget, f"respawn {kill['respawn_s']}s > {budget}s"
+assert integ["validated"] > 0, integ
+assert integ["mismatched"] == 0, f"torn/mixed responses: {integ}"
+assert s["workers"]["healthy"] == 2, s["workers"]
+assert s["workers"]["deaths_total"] == 1, s["workers"]
+assert s["router"]["retries_total"] >= 1, \
+    "the SIGKILL mid-load should have forced at least one router retry"
+print(f"worker drill OK: availability {s['availability']}, "
+      f"respawn {kill['respawn_s']}s, "
+      f"{int(s['router']['retries_total'])} retries absorbed, "
+      f"{integ['validated']} validated responses, 0 torn")
+EOF
+
+echo "worker drill OK"

@@ -284,3 +284,52 @@ family = "resnet50"
         ParallelConfig(mode="pipeline")
     with pytest.raises(ValueError, match="n_chips"):
         ParallelConfig(data=-1)
+
+
+def test_router_and_worker_blocks(tmp_path):
+    """[router]/[worker] (ISSUE 8): the process-split plan parses from TOML
+    and dot-path overrides; invalid knobs reject at construction."""
+    from tpuserve.config import RouterConfig, WorkerConfig
+
+    p = tmp_path / "serve.toml"
+    p.write_text(
+        """
+[router]
+enabled = true
+workers = 4
+retry_max = 1
+hedge_ms = 25.0
+respawn_initial_s = 0.25
+
+[worker]
+port_base = 9100
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.router.enabled and cfg.router.workers == 4
+    assert cfg.router.retry_max == 1 and cfg.router.hedge_ms == 25.0
+    assert cfg.router.respawn_initial_s == 0.25
+    assert cfg.worker.port_base == 9100
+    assert cfg.worker.host == "127.0.0.1"
+
+    cfg = load_config(str(p), overrides=["router.workers=8",
+                                         "worker.drain_timeout_s=2.5"])
+    assert cfg.router.workers == 8
+    assert cfg.worker.drain_timeout_s == 2.5
+
+    # Defaults: single-process serving, split off.
+    assert ServerConfig().router.enabled is False
+    with pytest.raises(ValueError, match="router.workers"):
+        RouterConfig(workers=0)
+    with pytest.raises(ValueError, match="retry_max"):
+        RouterConfig(retry_max=-1)
+    with pytest.raises(ValueError, match="respawn"):
+        RouterConfig(respawn_multiplier=0.5)
+    with pytest.raises(ValueError, match="unhealthy_after"):
+        RouterConfig(unhealthy_after=0)
+    with pytest.raises(ValueError, match="port_base"):
+        WorkerConfig(port_base=-1)

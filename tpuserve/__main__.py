@@ -1,3 +1,9 @@
+import sys
+
 from tpuserve.cli import main
 
-raise SystemExit(main())
+# Guarded: multiprocessing's spawn start method re-imports the parent's
+# __main__ in every child (router workers, deferred workers under spawn);
+# an unguarded entry would re-run the whole CLI inside each of them.
+if __name__ == "__main__":
+    sys.exit(main())

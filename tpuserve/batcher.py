@@ -746,6 +746,23 @@ class ModelBatcher:
         await run_split(reqs)
 
     # -- introspection -------------------------------------------------------
+    def estimate_clear_s(self) -> float | None:
+        """Estimated seconds for the current queue to clear at the observed
+        serving rate — the live ``Retry-After`` basis for queue-full 429s
+        (docs/ROBUSTNESS.md). Rate = the best items/s any bucket has
+        demonstrated (its size over its batch-duration EWMA), so the hint
+        tracks what the device is actually doing instead of a constant.
+        None before any batch has completed (no EWMA yet) or with an empty
+        queue."""
+        if self._pending <= 0:
+            return None
+        rate = max((b[0] / (ms / 1e3)
+                    for b, ms in self._ewma_ms.items() if ms > 0),
+                   default=0.0)
+        if rate <= 0:
+            return None
+        return self._pending / rate
+
     def pipeline_stats(self) -> dict:
         """The /stats "pipeline" block entry for this model
         (docs/PERFORMANCE.md "Reading the metrics")."""

@@ -3,9 +3,10 @@
 Usage::
 
     python -m tpuserve serve  --config serve.toml [--set port=9000 ...]
+        ([router] enabled = true starts the router tier + worker processes)
     python -m tpuserve bench  --url http://127.0.0.1:8000 --model resnet50 ...
     python -m tpuserve chaos  --config chaos.toml --min-availability 0.99 \
-                              [--drill reload]
+                              [--drill reload | --drill worker_kill]
     python -m tpuserve import-model --saved-model DIR --family resnet50 --out CKPT
     python -m tpuserve warmup --config serve.toml   (compile + persist XLA cache)
     python -m tpuserve lint                          (concurrency/drift analysis)
@@ -113,13 +114,23 @@ def main(argv: list[str] | None = None) -> int:
                          help="open-loop offered rate (req/s); default closed loop")
     p_chaos.add_argument("--min-availability", type=float, default=0.0,
                          help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
-    p_chaos.add_argument("--drill", choices=["reload"], default=None,
-                         help="additionally drive an admin drill during the "
-                              "run: 'reload' POSTs :reload on an interval so "
+    p_chaos.add_argument("--drill", choices=["reload", "worker_kill"],
+                         default=None,
+                         help="additionally drive a drill during the run: "
+                              "'reload' POSTs :reload on an interval so "
                               "reload_* fault rules prove the lifecycle "
-                              "gates hold availability")
+                              "gates hold availability; 'worker_kill' "
+                              "serves a real router + worker fleet and "
+                              "SIGKILLs one worker mid-load "
+                              "(docs/ROBUSTNESS.md)")
     p_chaos.add_argument("--drill-interval", type=float, default=0.5,
                          help="seconds between drill operations")
+    p_chaos.add_argument("--kill-after", type=float, default=None,
+                         help="worker_kill: seconds after warmup before the "
+                              "SIGKILL (default: 25%% of the run)")
+    p_chaos.add_argument("--respawn-budget", type=float, default=120.0,
+                         help="worker_kill: seconds the killed worker has "
+                              "to come back healthy (backoff + boot)")
 
     p_warm = sub.add_parser("warmup", help="AOT-compile all buckets, persist XLA cache")
     _add_config_args(p_warm)
@@ -139,7 +150,6 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.cmd == "serve":
         from tpuserve.config import default_config, load_config
-        from tpuserve.server import serve
 
         if args.config:
             cfg = load_config(args.config, args.overrides)
@@ -149,7 +159,17 @@ def main(argv: list[str] | None = None) -> int:
                 from tpuserve.config import _apply_override
 
                 _apply_override(cfg, ov)
-        serve(cfg)
+        if cfg.router.enabled:
+            # Router/worker split (docs/ROBUSTNESS.md "Process failure
+            # domains"): this process is the device-free front tier; the
+            # supervisor spawns the worker processes that build models.
+            from tpuserve.workerproc import serve_router
+
+            serve_router(cfg)
+        else:
+            from tpuserve.server import serve
+
+            serve(cfg)
         return 0
 
     if args.cmd == "bench":
@@ -161,21 +181,33 @@ def main(argv: list[str] | None = None) -> int:
         import asyncio
 
         from tpuserve.config import default_config, load_config
-        from tpuserve.faults import run_chaos
-        from tpuserve.parallel import init_distributed
-        from tpuserve.server import ServerState, configure_logging
+        from tpuserve.server import configure_logging
 
         cfg = load_config(args.config, args.overrides) if args.config else default_config()
         configure_logging(cfg)
-        init_distributed(cfg.distributed)
-        state = ServerState(cfg)
-        state.build()
         model = args.model or cfg.models[0].name
-        summary = asyncio.run(run_chaos(
-            state, model, duration_s=args.duration, warmup_s=args.warmup,
-            concurrency=args.concurrency, rate_per_s=args.rate,
-            edge=cfg.model(model).wire_size, drill=args.drill,
-            drill_interval_s=args.drill_interval))
+        if args.drill == "worker_kill":
+            # Multi-process drill: this process stays device-free (the
+            # router never touches a chip); the fleet builds the models.
+            from tpuserve.workerproc.drill import run_worker_kill_drill
+
+            summary = asyncio.run(run_worker_kill_drill(
+                cfg, model, duration_s=args.duration, warmup_s=args.warmup,
+                concurrency=args.concurrency, kill_after_s=args.kill_after,
+                respawn_budget_s=args.respawn_budget))
+        else:
+            from tpuserve.faults import run_chaos
+            from tpuserve.parallel import init_distributed
+            from tpuserve.server import ServerState
+
+            init_distributed(cfg.distributed)
+            state = ServerState(cfg)
+            state.build()
+            summary = asyncio.run(run_chaos(
+                state, model, duration_s=args.duration, warmup_s=args.warmup,
+                concurrency=args.concurrency, rate_per_s=args.rate,
+                edge=cfg.model(model).wire_size, drill=args.drill,
+                drill_interval_s=args.drill_interval))
         print(json.dumps(summary, indent=2))
         return 0 if summary["availability"] >= args.min_availability else 1
 

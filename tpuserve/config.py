@@ -42,6 +42,9 @@ FAULT_KINDS = (
     "reload_corrupt",   # fail the reload integrity check (checksum mismatch)
     "reload_nan",       # fail the reload NaN/Inf scan (poisoned checkpoint)
     "reload_regressed", # fail the staged canary (regressed weights)
+    "worker_crash",     # os._exit the serving process mid-request (native crash)
+    "worker_hang",      # wedge the serving process: the request never answers
+    "worker_slow",      # sleep delay_ms in the serving process before decode
 )
 
 
@@ -278,6 +281,89 @@ class ParallelConfig:
 
 
 @dataclass
+class RouterConfig:
+    """Router/worker process split (``[router]`` TOML; tpuserve.workerproc,
+    docs/ROBUSTNESS.md "Process failure domains").
+
+    Off by default — the single-process server is unchanged. When enabled,
+    ``tpuserve serve`` starts a **router** process owning HTTP/JSON, the
+    result cache + single-flight coalescing, admission/deadline stamping,
+    and per-model circuit breakers, plus ``workers`` isolated worker
+    processes each owning batching + the TPU runtime (Clipper's layered
+    architecture, PAPERS.md P1). A supervisor health-checks workers, reaps
+    dead ones, and respawns them with exponential backoff; the router
+    re-dispatches idempotent work to a surviving worker on transport
+    failure (never past the request's absolute deadline) and hedges slow
+    attempts — one misbehaving or crashed worker costs capacity, never
+    availability."""
+
+    enabled: bool = False
+    # Worker processes to supervise (each builds every configured model).
+    workers: int = 2
+    # Transport-failure re-dispatches per request (connection refused/reset,
+    # a worker dying mid-request). Definitive worker answers (any HTTP
+    # status from a live worker except 503-not-admitted) are NEVER retried:
+    # a 500 means the work already executed and failed — re-running it
+    # would double-execute. Retries always honor the admission deadline.
+    retry_max: int = 2
+    # > 0: an attempt silent for this long gets a duplicate dispatched to a
+    # different worker; first definitive answer wins, the loser is
+    # cancelled (tail-latency hedging; covers a wedged-but-alive worker).
+    hedge_ms: float = 0.0
+    # TCP connect budget per attempt.
+    connect_timeout_ms: float = 500.0
+    # Supervisor HTTP health-probe cadence and per-probe budget.
+    health_interval_s: float = 0.5
+    health_timeout_ms: float = 1000.0
+    # Consecutive failed probes before a live process is routed around.
+    unhealthy_after: int = 3
+    # Exponential respawn backoff for dead workers:
+    # min(max_s, initial_s * multiplier^consecutive_failures).
+    respawn_initial_s: float = 0.5
+    respawn_max_s: float = 30.0
+    respawn_multiplier: float = 2.0
+    # Worker boot budget (spawn -> ready handshake), seconds. Generous:
+    # a cold worker AOT-compiles every bucket.
+    spawn_timeout_s: float = 900.0
+
+    def __post_init__(self) -> None:
+        if self.workers < 1:
+            raise ValueError(f"router.workers must be >= 1, got {self.workers}")
+        if self.retry_max < 0 or self.hedge_ms < 0:
+            raise ValueError("router.retry_max/hedge_ms must be >= 0")
+        if self.respawn_initial_s < 0 or self.respawn_max_s <= 0 \
+                or self.respawn_multiplier < 1.0:
+            raise ValueError(
+                "router.respawn_initial_s must be >= 0, respawn_max_s > 0, "
+                "respawn_multiplier >= 1")
+        if self.health_interval_s <= 0 or self.unhealthy_after < 1:
+            raise ValueError(
+                "router.health_interval_s must be > 0 and unhealthy_after >= 1")
+
+
+@dataclass
+class WorkerConfig:
+    """Worker-process side of the router split (``[worker]`` TOML;
+    tpuserve.workerproc.worker). Workers are full single-process servers
+    bound to loopback; the router relays to them."""
+
+    # Bind address for worker HTTP listeners (loopback: workers are an
+    # internal tier, never exposed).
+    host: str = "127.0.0.1"
+    # Worker i listens on port_base + i; 0 = ephemeral ports (recommended —
+    # the supervisor learns them from the ready handshake).
+    port_base: int = 0
+    # Per-worker SIGTERM drain budget; 0 = inherit the server's
+    # drain_timeout_s.
+    drain_timeout_s: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.port_base < 0 or self.drain_timeout_s < 0:
+            raise ValueError(
+                "worker.port_base/drain_timeout_s must be >= 0")
+
+
+@dataclass
 class ModelConfig:
     """Per-model serving configuration."""
 
@@ -424,6 +510,11 @@ class ServerConfig:
     # Multi-chip serving plan: replica-per-chip vs sharded-batch over the
     # local mesh (docs/PERFORMANCE.md "Serving on the mesh").
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    # Router/worker process split: multi-process failure domains with
+    # supervision + hedged retry (docs/ROBUSTNESS.md). Off by default.
+    router: RouterConfig = field(default_factory=RouterConfig)
+    # Worker-process knobs for the router split (loopback bind, drain).
+    worker: WorkerConfig = field(default_factory=WorkerConfig)
     models: list[ModelConfig] = field(default_factory=list)
     # Host-side decode threadpool size.
     decode_threads: int = 8
@@ -511,6 +602,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
     parallel_dict = raw.pop("parallel", None)
+    router_dict = raw.pop("router", None)
+    worker_dict = raw.pop("worker", None)
     faults_dict = raw.pop("faults", None)
     lifecycle_dict = raw.pop("lifecycle", None)
     pipeline_dict = raw.pop("pipeline", None)
@@ -522,6 +615,10 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
         cfg.distributed = _build(DistributedConfig, dist_dict)
     if parallel_dict is not None:
         cfg.parallel = _build(ParallelConfig, parallel_dict)
+    if router_dict is not None:
+        cfg.router = _build(RouterConfig, router_dict)
+    if worker_dict is not None:
+        cfg.worker = _build(WorkerConfig, worker_dict)
     if lifecycle_dict is not None:
         cfg.lifecycle = _build(LifecycleConfig, lifecycle_dict)
     if pipeline_dict is not None:

@@ -11,7 +11,11 @@ holds both sides of that story:
   rule-local seeded RNGs so a chaos run replays exactly. Call sites live in
   the batcher (batch_error, slow_dispatch, kill_group_loop), the runtime
   (device_error, slow_compute), the deferred pool (worker_death), the
-  server (decode_corrupt, canary_fail), and the reload lifecycle
+  server (decode_corrupt, canary_fail, plus the process-boundary kinds
+  worker_slow / worker_hang / worker_crash that degrade, wedge, or
+  os._exit the serving process — behind the router split
+  (tpuserve.workerproc) they prove hedging/retry/supervision, drilled by
+  ``tpuserve chaos --drill worker_kill``), and the reload lifecycle
   (reload_corrupt / reload_nan at the staging gates in
   ModelRuntime.stage_params, reload_regressed at the staged canary in
   tpuserve.lifecycle — drill them with ``tpuserve chaos --drill reload``).

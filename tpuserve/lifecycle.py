@@ -25,7 +25,12 @@ pipeline, every step of which keeps the old version serving on failure:
    ``lifecycle.soak_s``) auto-rolls back to the retained tree.
 
 `POST .../{name}:rollback` exposes the same rollback manually and
-`GET .../{name}/versions` the transition history. Metrics: ``model_version``
+`GET .../{name}/versions` the transition history. Behind the router split
+(tpuserve.workerproc) each worker process owns one of these lifecycles and
+the router fans ``:reload`` out to EVERY live worker atomically: any gate
+failure rolls the workers that published back, so the fleet never serves
+mixed versions, and a success bumps the router's cache generation so
+stale cached answers invalidate fleet-wide. Metrics: ``model_version``
 gauge, ``reloads_total`` / ``reload_rejected_total{stage=}`` /
 ``rollbacks_total{reason=}`` counters (tpuserve.obs). Chaos kinds
 ``reload_corrupt`` / ``reload_nan`` / ``reload_regressed`` fire at gates 1-2

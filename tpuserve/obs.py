@@ -252,6 +252,34 @@ class Metrics:
         return self.gauge(
             f"replica_inflight{{model={model},replica={replica}}}")
 
+    def worker_up_gauge(self, worker: int) -> Gauge:
+        """worker_up{worker=}: 1 while the supervised worker process is
+        alive and passing health probes, 0 while dead/respawning/unhealthy
+        (tpuserve.workerproc.supervisor). The fleet's availability at a
+        glance: sum(worker_up) is the live serving capacity. Prebound at
+        supervisor construction — never call per probe."""
+        return self.gauge(f"worker_up{{worker={worker}}}")
+
+    def worker_respawns_counter(self, worker: int) -> Counter:
+        """worker_respawns_total{worker=}: times the supervisor respawned
+        this worker slot after its process died (SIGKILL, native crash,
+        OOM). A climbing counter on one slot with worker_up stuck at 0 is
+        a crash loop — the respawn backoff (worker_backoff_s) shows how
+        hard the supervisor is backing off."""
+        return self.counter(f"worker_respawns_total{{worker={worker}}}")
+
+    def worker_backoff_gauge(self, worker: int) -> Gauge:
+        """worker_backoff_s{worker=}: the exponential respawn delay the
+        supervisor applied to this slot's most recent respawn (0 once it
+        is back up and healthy)."""
+        return self.gauge(f"worker_backoff_s{{worker={worker}}}")
+
+    def worker_inflight_gauge(self, worker: int) -> Gauge:
+        """worker_inflight{worker=}: relayed requests currently in flight
+        on one worker (tpuserve.workerproc.router feeds the least-loaded
+        pick from it)."""
+        return self.gauge(f"worker_inflight{{worker={worker}}}")
+
     def set_model_version(self, model: str, version: int) -> None:
         """model_version{model=}: the live weight-tree version number
         (tpuserve.lifecycle). A sawtooth on a dashboard = publish followed

@@ -38,6 +38,7 @@ import functools
 import json
 import logging
 import math
+import os
 import signal
 import time
 
@@ -69,6 +70,11 @@ STATE_KEY: "web.AppKey[ServerState]" = web.AppKey("tpuserve_state", object)
 # enough to stall every other in-flight response at high request rates).
 # Smaller responses stay inline: the executor hop costs more than it saves.
 _JSON_OFFLOAD_MIN_ITEMS = 32
+
+# Injected worker_hang wedge duration: long enough that the request never
+# answers within any sane deadline (the router's hedging/504 owns it), short
+# enough that a forgotten armed rule can't pin a connection forever.
+_WORKER_HANG_S = 3600.0
 
 
 def _dumps_utf8(obj) -> bytes:
@@ -119,6 +125,13 @@ class ServerState:
         self.handles: dict[str, ModelHandles] = {}
         self.canary_ok: dict[str, bool] = {}
         self._canary_task: asyncio.Task | None = None
+        # Next periodic-canary fire time (time.monotonic clock): the live
+        # basis for breaker-503 Retry-After hints (the canary IS the
+        # recovery probe, so "retry after the next canary" is exact).
+        self._next_canary_at: float | None = None
+        # Worker-process id when this server runs behind the router tier
+        # (tpuserve.workerproc); None in single-process mode.
+        self.worker_id: int | None = None
         # Chaos layer (docs/ROBUSTNESS.md): None unless [faults] is armed.
         self.injector = (FaultInjector(cfg.faults, self.metrics)
                          if cfg.faults.enabled else None)
@@ -244,6 +257,7 @@ class ServerState:
         under ordinary load when canary_interval_s was small)."""
         timeouts = self.canary_timeouts()
         while True:
+            self._next_canary_at = time.monotonic() + self.cfg.canary_interval_s
             await asyncio.sleep(self.cfg.canary_interval_s)
             try:
                 await self.run_canaries(timeouts=timeouts)
@@ -304,7 +318,18 @@ class ServerState:
     async def drain(self) -> bool:
         """SIGTERM path: refuse new work, then wait (<= drain_timeout_s) for
         every accepted request to finish — a rolling restart drops zero
-        accepted requests. Returns False if the budget expired first."""
+        accepted requests. Returns False if the budget expired first.
+
+        The revival machinery stops FIRST: the watchdog must not revive a
+        group loop (or background-respawn a deferred worker) that this
+        drain is intentionally quiescing, and the periodic canary must not
+        inject new probe work after admission closed. The old ordering left
+        both running until state.stop() — a stop/revive race window where a
+        post-drain sweep could recreate machinery stop() was about to tear
+        down (and, for deferred pools, fork a multi-second replacement
+        worker nobody would ever use)."""
+        await self.watchdog.stop()
+        await self._stop_canary_loop()
         self.begin_drain()
         # Early-retire deferred epochs so pending futures resolve in
         # readback time instead of at the epoch deadline.
@@ -370,26 +395,51 @@ class ServerState:
         return out
 
     def shed_retry_after(self) -> int:
-        """Retry-After seconds for 429 shed / drain 503 responses."""
+        """Retry-After seconds for drain 503 responses (hint to hit another
+        replica — this one is going away, so there is no live state to
+        derive a better number from)."""
         return max(1, math.ceil(self.cfg.shed_retry_after_s))
 
+    def queue_retry_after(self, name: str) -> int:
+        """Retry-After seconds for queue-full 429s, derived from live state:
+        the batcher's estimated queue-clear time at the observed serving
+        rate (per-bucket duration EWMAs), clamped to [1, 30] s. Falls back
+        to the configured constant before any batch has completed."""
+        b = self.batchers.get(name)
+        est = b.estimate_clear_s() if b is not None else None
+        if est is None:
+            return self.shed_retry_after()
+        return max(1, min(30, math.ceil(est)))
+
     def breaker_retry_after(self, name: str) -> int:
-        """Retry-After seconds for breaker 503s: the canary interval when
-        periodic canaries drive recovery, else the model's configured hint."""
+        """Retry-After seconds for breaker 503s, derived from live state:
+        the time until the NEXT periodic canary — the probe that half-opens
+        and closes the breaker — when canaries drive recovery (the interval
+        itself before the loop has armed a fire time), else the model's
+        configured hint."""
         if self.cfg.canary_interval_s > 0:
+            if self._next_canary_at is not None:
+                eta = self._next_canary_at - time.monotonic()
+                if eta > 0:
+                    return max(1, math.ceil(eta))
+                return 1  # probe due now: retry immediately after it lands
             return max(1, math.ceil(self.cfg.canary_interval_s))
         br = self.breakers.get(name)
         return max(1, math.ceil(br.retry_after_s if br else 1.0))
 
-    async def stop(self) -> None:
-        await self.watchdog.stop()
-        for lc in self.lifecycles.values():
-            lc.close()  # stop soak monitors
+    async def _stop_canary_loop(self) -> None:
+        """Cancel the periodic canary task (idempotent; drain + stop)."""
         if self._canary_task is not None:
             self._canary_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await self._canary_task
             self._canary_task = None
+
+    async def stop(self) -> None:
+        await self.watchdog.stop()
+        for lc in self.lifecycles.values():
+            lc.close()  # stop soak monitors
+        await self._stop_canary_loop()
         # Deferred pools first retire their active workers (fast) so batcher
         # dispatch tasks awaiting epoch readback resolve in readback time,
         # not at the epoch deadline; then drain batchers, then stop pools.
@@ -428,6 +478,25 @@ async def handle_predict(request: web.Request) -> web.Response:
     mcfg = h.mcfg
     h.requests.inc()
     t_start = time.perf_counter()
+
+    if state.injector is not None:
+        # Process-boundary chaos (docs/ROBUSTNESS.md "Process failure
+        # domains"): simulate a degraded (worker_slow), wedged (worker_hang
+        # — the request simply never answers), or natively-crashed
+        # (worker_crash — the whole process exits, taking every in-flight
+        # request with it) serving process. Behind the router tier these
+        # prove hedging, retry, and supervision; in single-process mode
+        # they demonstrate exactly the blast radius the split removes.
+        delay = state.injector.delay_s("worker_slow", name)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        if state.injector.fire("worker_hang", name) is not None:
+            await asyncio.sleep(_WORKER_HANG_S)
+            return _err(503, "wedged worker unwedged; retry")
+        if state.injector.fire("worker_crash", name) is not None:
+            log.error("chaos: worker_crash fired for %s — exiting process",
+                      name)
+            os._exit(17)
 
     body = await request.read()
     ctype = request.content_type or ""
@@ -495,7 +564,7 @@ async def handle_predict(request: web.Request) -> web.Response:
         for f in futs:
             f.cancel()
         return _err(429, "queue full, retry later",
-                    retry_after=state.shed_retry_after())
+                    retry_after=state.queue_retry_after(name))
     except RuntimeError as e:
         # Batcher stopped/not started: requests racing shutdown get a clean
         # retryable status instead of an unhandled 500.

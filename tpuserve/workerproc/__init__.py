@@ -1,0 +1,42 @@
+"""Router/worker process split (ISSUE 8; docs/ROBUSTNESS.md "Process
+failure domains").
+
+The single-process server is one GIL, one event loop, one failure domain: a
+wedged handler or a native crash in the runtime takes the HTTP front door
+down with it. This package splits the deployment into **failure domains**
+(Clipper's layered architecture, PAPERS.md P1):
+
+- ``worker``   — the process entry for one isolated serving process: a full
+  single-process tpuserve server (batching, hostpipe, runtime, lifecycle,
+  its own watchdog and graceful drain) bound to loopback, announced to the
+  supervisor over a pipe handshake.
+- ``supervisor`` — spawns/owns N workers, health-checks them over HTTP,
+  reaps dead processes, and respawns them with exponential backoff
+  (extending PR 1's Watchdog: the process-liveness sweep is registered with
+  it, so respawns land in ``watchdog_restarts_total``).
+- ``router``   — the front tier: owns HTTP/JSON, admission + deadline
+  stamping, the result cache + single-flight coalescing, and per-model
+  circuit breakers; relays requests to the least-loaded healthy worker with
+  transport-failure retry and tail-latency hedging, never past a request's
+  absolute deadline.
+- ``drill``    — the ``python -m tpuserve chaos --drill worker_kill``
+  backend: SIGKILL a worker under closed-loop load and measure that
+  availability holds, the supervisor respawns within its backoff budget,
+  and no response is torn or duplicated (PAPERS.md P6).
+
+Enable with ``[router] enabled = true``; the default single-process path is
+untouched.
+"""
+
+from tpuserve.workerproc.router import RouterState, make_router_app, serve_router
+from tpuserve.workerproc.supervisor import WorkerHandle, WorkerSupervisor
+from tpuserve.workerproc.worker import worker_main
+
+__all__ = [
+    "RouterState",
+    "WorkerHandle",
+    "WorkerSupervisor",
+    "make_router_app",
+    "serve_router",
+    "worker_main",
+]

@@ -1,0 +1,161 @@
+"""Kill-worker chaos drill (``python -m tpuserve chaos --drill worker_kill``;
+PAPERS.md P6 — a resilience property you haven't injected a fault against
+is a hope, not a property).
+
+The drill serves a REAL router + N worker processes on an ephemeral port,
+drives the closed-loop load generator at one model, then SIGKILLs one
+worker mid-load (uncatchable — exactly a native crash / OOM kill) and
+measures the properties the process split promises:
+
+- **availability** — n_ok / (n_ok + n_err) over the whole run, kill
+  included, must hold the bound (default >= 99%): in-flight requests on
+  the victim surface as transport errors the router retries on survivors.
+- **respawn_s** — time from the SIGKILL until the victim's slot is healthy
+  again; gated against the configured backoff plus a spawn budget.
+- **torn / duplicate responses** — a validator task runs one known payload
+  in a closed loop throughout and byte-compares every 200 body against a
+  pre-kill reference (workers build identical seeded weights, so answers
+  are deterministic): any mismatch is a torn or mixed response, and every
+  validator request is counted exactly once, so a duplicated answer would
+  surface as a protocol error. Both must be zero.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import time
+
+from tpuserve.config import ServerConfig
+
+log = logging.getLogger("tpuserve.workerproc")
+
+
+async def _validator(url: str, payload: bytes, ctype: str, ref: bytes,
+                     stop: asyncio.Event, out: dict) -> None:
+    """Closed-loop correctness probe: every 200 body must equal the
+    reference byte-for-byte; non-200s are availability's business."""
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        while not stop.is_set():
+            try:
+                async with session.post(
+                        url, data=payload, headers={"Content-Type": ctype},
+                        timeout=aiohttp.ClientTimeout(total=30.0)) as r:
+                    body = await r.read()
+                    if r.status == 200:
+                        out["validated"] += 1
+                        if body != ref:
+                            out["mismatched"] += 1
+                            log.error("torn/mixed response: %r != ref",
+                                      body[:128])
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — resets count via loadgen
+                out["transport_errors"] += 1
+            await asyncio.sleep(0.01)
+
+
+async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None,
+                                duration_s: float = 20.0, warmup_s: float = 1.0,
+                                concurrency: int = 16,
+                                kill_after_s: float | None = None,
+                                respawn_budget_s: float = 120.0) -> dict:
+    """Serve a router fleet, SIGKILL one worker mid-load, report the
+    availability / respawn / integrity numbers. The caller owns asserting
+    the bounds (CLI gates availability; scripts/worker_drill.sh gates the
+    rest)."""
+    from aiohttp import web
+
+    from tpuserve.bench.loadgen import run_load, synthetic_image_npy
+    from tpuserve.workerproc.router import RouterState, make_router_app
+
+    cfg.router.enabled = True
+    cfg.router.workers = max(2, cfg.router.workers)
+    # Every validated response must be a real execution: a cache would
+    # happily serve perfect answers from a fleet of corpses.
+    cfg.cache.enabled = False
+    model = model_name or cfg.models[0].name
+
+    state = RouterState(cfg)
+    app = make_router_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()  # on_startup spawns the fleet
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    url = f"http://127.0.0.1:{port}/v1/models/{model}:predict"
+    payload = synthetic_image_npy(edge=cfg.model(model).wire_size)
+    ctype = "application/x-npy"
+
+    kill_info: dict = {}
+    integrity = {"validated": 0, "mismatched": 0, "transport_errors": 0}
+    stop_validator = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    async def _reference() -> bytes:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, data=payload,
+                              headers={"Content-Type": ctype}) as r:
+                body = await r.read()
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"reference request failed: {r.status} {body[:200]}")
+                return body
+
+    async def _killer() -> None:
+        await asyncio.sleep(warmup_s + (kill_after_s
+                                        if kill_after_s is not None
+                                        else duration_s * 0.25))
+        victim = state.supervisor.pick()
+        if victim is None:
+            kill_info["error"] = "no healthy worker to kill"
+            return
+        wid, pid = victim.wid, victim.pid
+        log.warning("drill: SIGKILL worker %d (pid %d)", wid, pid)
+        t0 = time.monotonic()
+        os.kill(pid, signal.SIGKILL)
+        kill_info.update(killed_worker=wid, killed_pid=pid)
+        deadline = t0 + respawn_budget_s
+        while time.monotonic() < deadline:
+            h = state.supervisor.slots[wid]
+            if h is not None and h.pid != pid and h.healthy:
+                kill_info["respawn_s"] = round(time.monotonic() - t0, 2)
+                return
+            await asyncio.sleep(0.05)
+        kill_info["respawn_s"] = None  # did not come back in budget
+
+    try:
+        ref = await _reference()
+        validator_task = loop.create_task(
+            _validator(url, payload, ctype, ref, stop_validator, integrity))
+        load_task = loop.create_task(
+            run_load(url, payload, ctype, duration_s, concurrency, warmup_s))
+        kill_task = loop.create_task(_killer())
+        result = await load_task
+        await kill_task
+        stop_validator.set()
+        await validator_task
+        workers = state.supervisor.stats()
+    finally:
+        await runner.cleanup()  # on_cleanup -> state.stop() -> fleet drain
+
+    out = result.summary()
+    total = result.n_ok + result.n_err
+    out["availability"] = round(result.n_ok / total, 5) if total else 0.0
+    out["drill"] = "worker_kill"
+    out["kill"] = kill_info
+    out["integrity"] = integrity
+    out["workers"] = workers
+    out["router"] = {
+        "retries_total": state.handles[model].retries.value,
+        "hedges_total": state.handles[model].hedges.value,
+        "respawn_budget_s": respawn_budget_s,
+        "respawn_backoff_initial_s": cfg.router.respawn_initial_s,
+    }
+    return out

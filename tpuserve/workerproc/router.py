@@ -1,0 +1,747 @@
+"""Router tier: the HTTP front door over N isolated worker processes.
+
+The router owns everything that must survive a worker death (Clipper's
+front tier, PAPERS.md P1): HTTP/JSON, admission + per-request deadline
+stamping, the content-addressed result cache with single-flight coalescing
+(PR 5's layer, hoisted above the process boundary so a cached answer
+outlives the worker that computed it), and per-model circuit breakers. It
+never touches a device — a worker taking its runtime down cannot take the
+front door with it.
+
+Relay semantics (the robustness contract, docs/ROBUSTNESS.md):
+
+- **Deadline stamping** — the absolute deadline is stamped once at router
+  admission; every forward carries ``X-Timeout-Ms`` = the budget REMAINING
+  at dispatch, so the worker re-stamps the same absolute instant on its own
+  clock. A request 504s at that instant whether it dies in the router, on
+  the wire, or inside a worker — and no retry or hedge ever extends it.
+- **Retry** — transport failures (connection refused/reset, a worker dying
+  mid-request) re-dispatch to a different healthy worker, up to
+  ``retry_max`` times within the deadline. Inference is idempotent, so
+  re-dispatching unanswered work is safe; a DEFINITIVE worker answer
+  (anything but a 503-not-admitted) is never re-dispatched — a 500 means
+  the work already executed and failed, and re-running it would
+  double-execute.
+- **Hedging** — with ``hedge_ms > 0``, an attempt silent that long gets a
+  duplicate dispatched to another worker; the first definitive answer wins
+  and the loser is cancelled. Covers the wedged-but-alive worker that
+  liveness checks can't see yet.
+- **Degradation** — a lost worker is lost capacity, not lost availability:
+  with any healthy worker the fleet keeps answering; with none, requests
+  shed fast with 503 + ``Retry-After`` derived from the supervisor's live
+  respawn backoff ETA. Breaker 503s carry the half-open probe ETA.
+- **Drain** — SIGTERM sequences across the boundary: the router stops
+  admitting (503 + Retry-After), waits for its in-flight relays, and only
+  then SIGTERMs the workers, each of which flushes its accepted batches
+  before exiting. Zero accepted requests dropped.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import math
+import signal
+import time
+
+import aiohttp
+from aiohttp import web
+
+from tpuserve.analysis import witness
+from tpuserve.cache import ModelCache
+from tpuserve.config import ServerConfig
+from tpuserve.faults import CircuitBreaker, Watchdog
+from tpuserve.obs import Metrics
+from tpuserve.server import _err, _requested_timeout_ms, configure_logging
+from tpuserve.workerproc.supervisor import WorkerHandle, WorkerSupervisor
+
+log = logging.getLogger("tpuserve.workerproc")
+
+_VERBS = ("predict", "classify", "detect", "generate")
+
+# Same backstop grace as the single-process HTTP timer: the worker enforces
+# the deadline precisely (fast 504 at the instant), the router's own wait
+# runs slightly late so the two never race.
+_DEADLINE_GRACE_S = 0.25
+
+
+class NoHealthyWorker(Exception):
+    """Every worker slot is dead/unhealthy; ``eta_s`` is the live respawn
+    backoff ETA (-> 503 + Retry-After)."""
+
+    def __init__(self, eta_s: float) -> None:
+        super().__init__("no healthy worker")
+        self.eta_s = eta_s
+
+
+class RelayDeadline(Exception):
+    """The request's absolute deadline expired while relaying (-> 504)."""
+
+
+class UpstreamFailed(Exception):
+    """Transport failures exhausted the retry budget (-> 503, retryable:
+    the work was never definitively executed)."""
+
+
+class _Answer:
+    """One complete worker response (body fully read — never torn)."""
+
+    __slots__ = ("status", "content_type", "body", "retry_after")
+
+    def __init__(self, status: int, content_type: str, body: bytes,
+                 retry_after: str | None) -> None:
+        self.status = status
+        self.content_type = content_type
+        self.body = body
+        self.retry_after = retry_after
+
+    def to_response(self) -> web.Response:
+        headers = {"Retry-After": self.retry_after} if self.retry_after else None
+        return web.Response(body=self.body, status=self.status,
+                            content_type=self.content_type, headers=headers)
+
+
+class _RelayedError(Exception):
+    """Non-200 relay outcome crossing the cache's single-flight machinery
+    (errors must fan out to coalesced waiters but never populate)."""
+
+    def __init__(self, ans: _Answer) -> None:
+        super().__init__(f"upstream answered {ans.status}")
+        self.ans = ans
+
+
+class RouterHandles:
+    """Per-model hot-path metric handles, prebound once (PR 5 discipline)."""
+
+    __slots__ = ("mcfg", "requests", "retries", "hedges", "timeouts",
+                 "latency")
+
+    def __init__(self, name: str, mcfg, metrics: Metrics) -> None:
+        self.mcfg = mcfg
+        self.requests = metrics.counter(f"router_requests_total{{model={name}}}")
+        self.retries = metrics.counter(f"router_retries_total{{model={name}}}")
+        self.hedges = metrics.counter(f"router_hedges_total{{model={name}}}")
+        self.timeouts = metrics.counter(f"router_timeouts_total{{model={name}}}")
+        self.latency = metrics.histogram(f"router_latency_ms{{model={name}}}")
+
+
+class RouterState:
+    """Everything a running router process owns."""
+
+    def __init__(self, cfg: ServerConfig) -> None:
+        self.cfg = cfg
+        self.rcfg = cfg.router
+        self.metrics = Metrics(cfg.trace_capacity)
+        self.supervisor = WorkerSupervisor(cfg, self.metrics)
+        self.watchdog = Watchdog(cfg.watchdog_interval_s, self.metrics)
+        self.handles: dict[str, RouterHandles] = {}
+        self.breakers: dict[str, CircuitBreaker] = {}
+        self.caches: dict[str, ModelCache] = {}
+        # Per-model config generation: bumped on every successful reload
+        # fan-out, and baked into every cache key (the router-tier analog
+        # of PR 5's version binding — a fleet-wide publish atomically
+        # invalidates all older entries).
+        self.generations: dict[str, int] = {}
+        # Next allowed breaker probe per model (time.monotonic): while a
+        # breaker is open, one request per breaker_retry_after_s is let
+        # through as the recovery probe; everyone else sheds with the
+        # half-open ETA as Retry-After.
+        self._probe_at: dict[str, float] = {}
+        self.draining = False
+        self._inflight = 0
+        self.serving_addresses: list = []
+        self._session: aiohttp.ClientSession | None = None
+        for mcfg in cfg.models:
+            name = mcfg.name
+            self.handles[name] = RouterHandles(name, mcfg, self.metrics)
+            self.breakers[name] = CircuitBreaker(
+                name, mcfg.breaker_threshold, self.metrics,
+                retry_after_s=mcfg.breaker_retry_after_s)
+            self.generations[name] = 1
+            if cfg.cache.enabled:
+                self.caches[name] = ModelCache(
+                    name, cfg.cache, self.metrics,
+                    version_fn=functools.partial(self.generations.get, name, 0))
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self) -> None:
+        if witness.maybe_install():
+            log.info("lock witness installed (TPUSERVE_LOCK_WITNESS)")
+        self._session = aiohttp.ClientSession()
+        await self.supervisor.start()
+        # Process-liveness sweep rides the same Watchdog as PR 1's group
+        # loops: a reaped+respawn-scheduled worker lands in
+        # watchdog_restarts_total{model=_router,component=worker}.
+        self.watchdog.register("_router", "worker", self.supervisor.sweep)
+        self.watchdog.start()
+
+    def begin_drain(self) -> None:
+        self.draining = True
+
+    async def drain(self) -> bool:
+        """SIGTERM step 1+2: stop the revival machinery (same discipline as
+        the single-process fix — the watchdog must not respawn a worker
+        this drain is about to SIGTERM), stop admitting, then wait for
+        every in-flight relay to resolve within the budget."""
+        await self.watchdog.stop()
+        self.begin_drain()
+        deadline = time.monotonic() + self.cfg.drain_timeout_s
+        while self._inflight > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        return self._inflight == 0
+
+    async def stop(self) -> None:
+        await self.watchdog.stop()
+        # Workers drain their accepted batches on SIGTERM; with the router
+        # already drained there is nothing in flight to lose.
+        await self.supervisor.stop(drain=True)
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # -- shed hints ----------------------------------------------------------
+    def no_worker_retry_after(self) -> int:
+        return max(1, math.ceil(self.supervisor.respawn_eta_s()))
+
+    def shed_retry_after(self) -> int:
+        return max(1, math.ceil(self.cfg.shed_retry_after_s))
+
+    # -- relay ---------------------------------------------------------------
+    async def _attempt(self, w: WorkerHandle, name: str, verb: str,
+                       body: bytes, ctype: str,
+                       deadline_at: float) -> _Answer:
+        """One complete request/response against one worker. The body is
+        fully read before returning, so a relayed response is never torn:
+        a worker dying mid-body surfaces as a transport error (and a
+        retry), not a truncated 200."""
+        remaining = deadline_at - time.perf_counter()
+        timeout = aiohttp.ClientTimeout(
+            total=max(0.001, remaining + _DEADLINE_GRACE_S),
+            connect=self.rcfg.connect_timeout_ms / 1e3)
+        headers = {"X-Timeout-Ms": f"{max(1.0, remaining * 1e3):.0f}"}
+        if ctype:
+            headers["Content-Type"] = ctype
+        self.supervisor.track_inflight(w, +1)
+        try:
+            async with self._session.post(
+                    f"{w.base_url}/v1/models/{name}:{verb}", data=body,
+                    headers=headers, timeout=timeout) as r:
+                raw = await r.read()
+                return _Answer(r.status, r.content_type or "application/json",
+                               raw, r.headers.get("Retry-After"))
+        finally:
+            self.supervisor.track_inflight(w, -1)
+
+    async def _relay(self, name: str, verb: str, body: bytes, ctype: str,
+                     deadline_at: float) -> _Answer:
+        """Dispatch to the least-loaded healthy worker with retry + hedging
+        under the absolute deadline. Returns the first definitive answer;
+        raises NoHealthyWorker / RelayDeadline / UpstreamFailed."""
+        h = self.handles[name]
+        tasks: dict[asyncio.Task, WorkerHandle] = {}
+        tried: set[int] = set()
+        retries_left = self.rcfg.retry_max
+        hedges_left = 1 if self.rcfg.hedge_ms > 0 else 0
+        last_503: _Answer | None = None
+        last_exc: Exception | None = None
+        loop = asyncio.get_running_loop()
+
+        def remaining() -> float:
+            return deadline_at - time.perf_counter()
+
+        def launch() -> bool:
+            w = self.supervisor.pick(exclude=tried)
+            if w is None and tried:
+                # Every healthy worker was already tried: allow a
+                # re-dispatch (the failure may have been transient and the
+                # fleet may be down to one survivor).
+                w = self.supervisor.pick()
+            if w is None:
+                return False
+            tried.add(w.wid)
+            t = loop.create_task(
+                self._attempt(w, name, verb, body, ctype, deadline_at))
+            tasks[t] = w
+            return True
+
+        def can_hedge() -> bool:
+            return (hedges_left > 0 and len(tasks) == 1
+                    and len(self.supervisor.healthy_workers()) > 1)
+
+        try:
+            if not launch():
+                raise NoHealthyWorker(self.supervisor.respawn_eta_s())
+            while True:
+                rem = remaining()
+                if rem <= -_DEADLINE_GRACE_S:
+                    raise RelayDeadline()
+                wait_s = rem + _DEADLINE_GRACE_S
+                if can_hedge():
+                    wait_s = min(wait_s, self.rcfg.hedge_ms / 1e3)
+                done, _ = await asyncio.wait(
+                    set(tasks), timeout=max(0.0, wait_s),
+                    return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    if can_hedge() and remaining() > 0:
+                        # Primary silent past hedge_ms: race a duplicate on
+                        # another worker. Safe for idempotent inference;
+                        # first definitive answer wins below.
+                        if launch():
+                            hedges_left -= 1
+                            h.hedges.inc()
+                        else:
+                            hedges_left = 0
+                        continue
+                    if remaining() <= -_DEADLINE_GRACE_S:
+                        raise RelayDeadline()
+                    continue
+                for t in done:
+                    tasks.pop(t)
+                    if t.cancelled():
+                        continue
+                    exc = t.exception()
+                    if exc is None:
+                        ans = await t  # already done: no suspension
+                        if ans.status != 503:
+                            # Definitive: the worker admitted and answered
+                            # (200, 4xx, 500, 504). NEVER re-dispatched —
+                            # a 500 already executed; re-running it would
+                            # double-execute.
+                            return ans
+                        # 503 = not admitted (worker draining / its own
+                        # breaker): the work never ran, so another worker
+                        # may take it.
+                        last_503 = ans
+                    elif isinstance(exc, (aiohttp.ClientError,
+                                          asyncio.TimeoutError, OSError)):
+                        if isinstance(exc, asyncio.TimeoutError) \
+                                and remaining() <= 0:
+                            raise RelayDeadline() from exc
+                        last_exc = exc
+                    else:
+                        raise exc  # programming error — surface it
+                    if remaining() > 0 and retries_left > 0 and launch():
+                        retries_left -= 1
+                        h.retries.inc()
+                if not tasks:
+                    if last_503 is not None:
+                        return last_503
+                    raise UpstreamFailed() from last_exc
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    async def relay_cacheable(self, name: str, verb: str, body: bytes,
+                              ctype: str, deadline_at: float) -> tuple:
+        """Cache-value form of _relay: returns ``(content_type, body)`` for
+        a 200 (what the single-flight leader populates), raises
+        _RelayedError for any other definitive answer (fans out to
+        coalesced waiters, populates nothing)."""
+        ans = await self._relay(name, verb, body, ctype, deadline_at)
+        if ans.status == 200:
+            return (ans.content_type, ans.body)
+        raise _RelayedError(ans)
+
+    # -- admin fan-out -------------------------------------------------------
+    def live_workers(self) -> list[WorkerHandle]:
+        """Every slot with a live process — admin fan-outs must reach
+        unhealthy-but-alive workers too, or the fleet's versions diverge."""
+        return [w for w in self.supervisor.slots
+                if w is not None and w.proc.is_alive()]
+
+    async def _admin_call(self, w: WorkerHandle, method: str,
+                          path: str) -> tuple[int, int, dict]:
+        try:
+            async with self._session.request(
+                    method, f"{w.base_url}{path}",
+                    timeout=aiohttp.ClientTimeout(total=120.0)) as r:
+                try:
+                    body = await r.json()
+                except Exception:  # noqa: BLE001 — non-JSON admin answer
+                    body = {"error": (await r.text())[:512]}
+                return w.wid, r.status, body
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — worker died mid-admin
+            return w.wid, 0, {"error": f"{type(e).__name__}: {e}"}
+
+    async def fanout_reload(self, name: str) -> tuple[int, dict]:
+        """Atomic fleet reload: POST ``:reload`` to every live worker; if
+        any worker fails its gates, roll the succeeded ones back so the
+        fleet never serves mixed versions. On success the router cache
+        generation bumps, atomically invalidating every older cached
+        answer (the cross-process analog of PR 5's version binding)."""
+        workers = self.live_workers()
+        if not workers:
+            return 503, {"error": "no live worker to reload",
+                         "workers": {}}
+        results = await asyncio.gather(
+            *(self._admin_call(w, "POST", f"/admin/models/{name}:reload")
+              for w in workers))
+        per_worker = {wid: {"status": status, **body}
+                      for wid, status, body in results}
+        if all(status == 200 for _, status, _ in results):
+            self.generations[name] = self.generations.get(name, 1) + 1
+            cache = self.caches.get(name)
+            if cache is not None:
+                cache.clear()
+            versions = {body.get("version") for _, _, body in results}
+            return 200, {"workers": per_worker,
+                         "version": results[0][2].get("version"),
+                         "fleet_consistent": len(versions) == 1}
+        # Partial failure: restore the workers that DID publish, so the
+        # fleet stays on one version (all-or-nothing).
+        succeeded = [w for w, (_, status, _) in zip(workers, results)
+                     if status == 200]
+        rolled_back = {}
+        if succeeded:
+            rb = await asyncio.gather(
+                *(self._admin_call(w, "POST",
+                                   f"/admin/models/{name}:rollback")
+                  for w in succeeded))
+            rolled_back = {wid: status for wid, status, _ in rb}
+        # A worker that published-then-rolled-back on its own (post-publish
+        # canary) means bad weights briefly served: 500 so operators page;
+        # a clean pre-publish rejection everywhere is a 409 conflict.
+        any_rb = any(body.get("rolled_back") for _, _, body in results)
+        status = 500 if (any_rb or succeeded) else 409
+        return status, {"error": "reload rejected by at least one worker; "
+                                 "fleet kept on one version",
+                        "workers": per_worker,
+                        "rolled_back_workers": rolled_back}
+
+    async def fanout_simple(self, name: str, op: str) -> tuple[int, dict]:
+        """Best-effort fan-out for ``:rollback`` (every live worker must
+        restore the same retained version) and ``/versions``."""
+        workers = self.live_workers()
+        if not workers:
+            return 503, {"error": "no live worker", "workers": {}}
+        if op == "rollback":
+            results = await asyncio.gather(
+                *(self._admin_call(w, "POST",
+                                   f"/admin/models/{name}:rollback")
+                  for w in workers))
+        else:
+            results = await asyncio.gather(
+                *(self._admin_call(w, "GET",
+                                   f"/admin/models/{name}/versions")
+                  for w in workers))
+        per_worker = {wid: {"status": status, **body}
+                      for wid, status, body in results}
+        ok = all(status == 200 for _, status, _ in results)
+        if ok and op == "rollback":
+            self.generations[name] = self.generations.get(name, 1) + 1
+            cache = self.caches.get(name)
+            if cache is not None:
+                cache.clear()
+        return (200 if ok else 409), {"workers": per_worker}
+
+
+# -- handlers ----------------------------------------------------------------
+
+ROUTER_KEY: "web.AppKey[RouterState]" = web.AppKey("tpuserve_router", object)
+
+
+def _predict_handler(verb: str):
+    """One closure per verb: aiohttp's literal ``:predict`` path segments
+    don't capture the verb into match_info, and the relay must forward the
+    verb the client used."""
+
+    async def handler(request: web.Request) -> web.Response:
+        return await handle_predict(request, verb)
+
+    return handler
+
+
+async def handle_predict(request: web.Request, verb: str) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    name = request.match_info["name"]
+    h = state.handles.get(name)
+    if h is None:
+        return _err(404, f"unknown model {name!r}")
+    # Shed checks BEFORE the body read, single-process discipline: a
+    # draining router, a tripped breaker, or an empty fleet answers in
+    # microseconds with a live-state Retry-After.
+    if state.draining:
+        return _err(503, "router draining; retry against another replica",
+                    retry_after=state.shed_retry_after())
+    breaker = state.breakers[name]
+    if not breaker.allow():
+        now = time.monotonic()
+        probe_at = state._probe_at.get(name, 0.0)
+        if now < probe_at:
+            breaker.on_shed()
+            return _err(503, f"circuit open for model {name!r}; recovery "
+                             "probe in progress",
+                        retry_after=max(1, math.ceil(probe_at - now)))
+        # This request IS the recovery probe: open -> half_open, let it
+        # through; its outcome closes or re-opens the breaker.
+        breaker.probe()
+        state._probe_at[name] = now + h.mcfg.breaker_retry_after_s
+    if not state.supervisor.healthy_workers():
+        return _err(503, "no healthy worker; capacity respawning",
+                    retry_after=state.no_worker_retry_after())
+    h.requests.inc()
+    t_start = time.perf_counter()
+
+    body = await request.read()
+    ctype = request.content_type or ""
+    try:
+        timeout_ms = _requested_timeout_ms(request, body, ctype)
+    except ValueError as e:
+        return _err(400, str(e))
+    timeout_s = (timeout_ms if timeout_ms is not None
+                 else h.mcfg.request_timeout_ms) / 1e3
+    deadline_at = t_start + timeout_s
+
+    state._inflight += 1
+    try:
+        ans = await _dispatch(state, name, verb, body, ctype, deadline_at)
+    except NoHealthyWorker as e:
+        breaker.record_failure()
+        return _err(503, "no healthy worker; capacity respawning",
+                    retry_after=max(1, math.ceil(e.eta_s)))
+    except (RelayDeadline, asyncio.TimeoutError):
+        h.timeouts.inc()
+        return _err(504,
+                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
+    except UpstreamFailed:
+        breaker.record_failure()
+        return _err(503, "workers unreachable; retry",
+                    retry_after=state.no_worker_retry_after())
+    finally:
+        state._inflight -= 1
+
+    if ans.status == 200:
+        breaker.record_success()
+    elif ans.status >= 500:
+        breaker.record_failure()
+    h.latency.observe((time.perf_counter() - t_start) * 1e3)
+    return ans.to_response()
+
+
+async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
+                    ctype: str, deadline_at: float) -> _Answer:
+    """Cache/single-flight front of the relay (router-owned PR-5 layer).
+
+    The cache key is content-addressed at the WIRE level — the router has
+    no models to decode with — so byte-identical uploads hit, and the
+    per-model config generation in every key makes a fleet reload an
+    atomic invalidation."""
+    cache = state.caches.get(name)
+    if cache is None:
+        return await state._relay(name, verb, body, ctype, deadline_at)
+    key = cache.key_for((verb, ctype, body))
+    entry = cache.get(key)
+    if entry is not None:
+        ct, raw = entry.value
+        return _Answer(200, ct, raw, None)
+    loop = asyncio.get_running_loop()
+    fut = cache.submit_through(
+        key, lambda: loop.create_task(
+            state.relay_cacheable(name, verb, body, ctype, deadline_at)))
+    # A coalesced waiter still honors ITS deadline: cancelling the waiter
+    # never cancels the leader's flight (ModelCache contract).
+    remaining = deadline_at - time.perf_counter()
+    try:
+        ct, raw = await asyncio.wait_for(
+            fut, max(0.0, remaining) + _DEADLINE_GRACE_S)
+    except _RelayedError as e:
+        return e.ans
+    return _Answer(200, ct, raw, None)
+
+
+async def handle_healthz(request: web.Request) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    sup = state.supervisor.stats()
+    if state.draining:
+        return web.json_response(
+            {"status": "draining", "workers": sup}, status=503)
+    healthy = sup["healthy"]
+    if healthy == 0:
+        return web.json_response(
+            {"status": "no_workers", "workers": sup}, status=503,
+            headers={"Retry-After": str(state.no_worker_retry_after())})
+    # Degraded capacity is NOT downtime: the front door keeps serving on
+    # the survivors while the supervisor respawns the rest, so the load
+    # balancer must not pull the whole replica (the graceful-degradation
+    # contract, docs/ROBUSTNESS.md).
+    status = "ok" if healthy == sup["configured"] else "degraded"
+    return web.json_response({"status": status, "workers": sup}, status=200)
+
+
+async def handle_metrics(request: web.Request) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    return web.Response(text=state.metrics.render_prometheus(),
+                        content_type="text/plain")
+
+
+async def handle_stats(request: web.Request) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    out = state.metrics.summary()
+    out["robustness"] = {
+        "draining": state.draining,
+        "breakers": {n: br.describe() for n, br in state.breakers.items()},
+    }
+    if witness.enabled():
+        out["robustness"]["lock_witness"] = witness.snapshot()
+    out["workers"] = state.supervisor.stats()
+    out["router"] = {
+        "generations": dict(state.generations),
+        "retry_max": state.rcfg.retry_max,
+        "hedge_ms": state.rcfg.hedge_ms,
+    }
+    if state.caches:
+        out["cache"] = {n: c.stats() for n, c in state.caches.items()}
+    return web.json_response(out)
+
+
+async def handle_models(request: web.Request) -> web.Response:
+    """Proxy the model inventory from the first healthy worker (every
+    worker serves an identical config)."""
+    state: RouterState = request.app[ROUTER_KEY]
+    w = state.supervisor.pick()
+    if w is None:
+        return _err(503, "no healthy worker",
+                    retry_after=state.no_worker_retry_after())
+    _, status, body = await state._admin_call(w, "GET", "/v1/models")
+    return web.json_response(body, status=status if status else 503)
+
+
+async def handle_worker_proxy(request: web.Request) -> web.Response:
+    """GET /workers/{wid}/{metrics|stats|healthz} — operator passthrough to
+    one worker's own introspection endpoints (workers bind loopback and are
+    otherwise unreachable from outside the host)."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        wid = int(request.match_info["wid"])
+    except ValueError:
+        return _err(400, "worker id must be an integer")
+    page = request.match_info["page"]
+    if page not in ("metrics", "stats", "healthz"):
+        return _err(404, f"unknown worker page {page!r}")
+    if not 0 <= wid < state.supervisor.n:
+        return _err(404, f"no worker slot {wid}")
+    w = state.supervisor.slots[wid]
+    if w is None:
+        return _err(503, f"worker {wid} is down (respawning)")
+    try:
+        async with state._session.get(
+                f"{w.base_url}/{page}",
+                timeout=aiohttp.ClientTimeout(total=10.0)) as r:
+            raw = await r.read()
+            return web.Response(body=raw, status=r.status,
+                                content_type=r.content_type or "text/plain")
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:  # noqa: BLE001
+        return _err(503, f"worker {wid} unreachable: {e}")
+
+
+async def handle_reload(request: web.Request) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    name = request.match_info["name"]
+    if name not in state.handles:
+        return _err(404, f"unknown model {name!r}")
+    status, body = await state.fanout_reload(name)
+    return web.json_response(body, status=status)
+
+
+async def handle_rollback(request: web.Request) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    name = request.match_info["name"]
+    if name not in state.handles:
+        return _err(404, f"unknown model {name!r}")
+    status, body = await state.fanout_simple(name, "rollback")
+    return web.json_response(body, status=status)
+
+
+async def handle_versions(request: web.Request) -> web.Response:
+    state: RouterState = request.app[ROUTER_KEY]
+    name = request.match_info["name"]
+    if name not in state.handles:
+        return _err(404, f"unknown model {name!r}")
+    status, body = await state.fanout_simple(name, "versions")
+    return web.json_response(body, status=status)
+
+
+async def handle_index(request: web.Request) -> web.Response:
+    from tpuserve.server import _INDEX_HTML
+
+    return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+
+# -- app wiring --------------------------------------------------------------
+
+def make_router_app(state: RouterState) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app[ROUTER_KEY] = state
+    for verb in _VERBS:
+        app.router.add_post(f"/v1/models/{{name}}:{verb}",
+                            _predict_handler(verb))
+    app.router.add_get("/v1/models", handle_models)
+    app.router.add_post("/admin/models/{name}:reload", handle_reload)
+    app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
+    app.router.add_get("/admin/models/{name}/versions", handle_versions)
+    app.router.add_get("/workers/{wid}/{page}", handle_worker_proxy)
+    app.router.add_get("/healthz", handle_healthz)
+    app.router.add_get("/metrics", handle_metrics)
+    app.router.add_get("/stats", handle_stats)
+    app.router.add_get("/", handle_index)
+
+    async def on_startup(app: web.Application) -> None:
+        await state.start()
+
+    async def on_cleanup(app: web.Application) -> None:
+        await state.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+async def serve_router_async(state: RouterState,
+                             ready: asyncio.Event | None = None) -> None:
+    """Serve the router until SIGTERM/SIGINT, then drain across the
+    process boundary: stop admitting -> in-flight relays resolve ->
+    workers flush accepted work and exit. Zero dropped requests."""
+    cfg = state.cfg
+    app = make_router_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, cfg.host, cfg.port)
+    await site.start()
+    state.serving_addresses = list(runner.addresses)
+    log.info("router serving on %s (%d workers)", state.serving_addresses,
+             cfg.router.workers)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    installed: list[signal.Signals] = []
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+            installed.append(sig)
+        except (NotImplementedError, RuntimeError):
+            pass
+    if ready is not None:
+        ready.set()
+    try:
+        await stop.wait()
+        log.info("shutdown signal: draining router (budget %.0fs)",
+                 cfg.drain_timeout_s)
+        drained = await state.drain()
+        if not drained:
+            log.warning("router drain budget expired with relays in flight")
+    finally:
+        for sig in installed:
+            loop.remove_signal_handler(sig)
+        await runner.cleanup()  # on_cleanup -> state.stop() (workers drain)
+
+
+def serve_router(cfg: ServerConfig) -> None:
+    """Blocking entry point for `[router] enabled = true` deployments."""
+    configure_logging(cfg)
+    state = RouterState(cfg)
+    asyncio.run(serve_router_async(state))

@@ -1,0 +1,130 @@
+"""Worker-process entry for the router split (docs/ROBUSTNESS.md).
+
+A worker is deliberately NOT a new kind of server: it is the existing
+single-process server (``tpuserve.server``) — batcher, hostpipe, runtime,
+lifecycle, watchdog, graceful SIGTERM drain — built in its own process and
+bound to loopback, so every property the single-process tests prove holds
+unchanged behind the boundary. What the process split adds lives in the
+supervisor and router, not here.
+
+Differences from a standalone server, all applied to the config before
+build:
+
+- binds ``[worker] host`` (loopback) on ``port_base + id`` or an ephemeral
+  port, and reports the bound port to the supervisor over a pipe handshake
+  (``{"op": "ready", "port": ...}``) — the same handshake idiom as the
+  deferred pool's workers;
+- the result cache is forced OFF: caching + single-flight coalescing are
+  router-owned (one shared cache beats N private ones, and a cached answer
+  must survive the worker that computed it);
+- ``[router]`` is forced off (a worker must never recurse into spawning
+  its own workers);
+- recycle-mode models are rejected up front: the deferred pool is its own
+  process-isolation story, and workers run as daemonic children which
+  cannot fork grandchildren.
+
+Deadlines cross the boundary as REMAINING budget (the gRPC convention):
+the router stamps the absolute deadline at admission and forwards
+``X-Timeout-Ms`` = time left at dispatch, which the existing
+``_requested_timeout_ms`` path re-stamps against this process's clock —
+so a request 504s at the same absolute instant whether it dies in the
+router, on the wire, or in here.
+
+SIGTERM drains gracefully via ``serve_async`` exactly as a standalone
+server does: stop admitting -> flush accepted -> exit. The supervisor
+sequences this after the router itself stopped admitting, so a rolling
+restart of the whole deployment drops zero accepted requests.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+
+from tpuserve.config import ServerConfig
+
+
+def worker_config(cfg: ServerConfig, worker_id: int) -> ServerConfig:
+    """Derive one worker's ServerConfig from the deployment config."""
+    for m in cfg.models:
+        if m.session_mode == "recycle":
+            raise ValueError(
+                f"model {m.name!r}: recycle-mode models cannot run behind "
+                "the router tier (the deferred pool is its own process "
+                "split, and daemonic workers cannot fork grandchildren); "
+                "serve them single-process")
+    wcfg = copy.deepcopy(cfg)
+    wcfg.host = cfg.worker.host
+    wcfg.port = (cfg.worker.port_base + worker_id
+                 if cfg.worker.port_base else 0)
+    if cfg.worker.drain_timeout_s > 0:
+        wcfg.drain_timeout_s = cfg.worker.drain_timeout_s
+    # Router-owned layers never run in the worker.
+    wcfg.router.enabled = False
+    wcfg.cache.enabled = False
+    return wcfg
+
+
+def worker_main(cfg: ServerConfig, worker_id: int, conn) -> None:
+    """Process entry (multiprocessing spawn target).
+
+    ``cfg`` is the WORKER config (worker_config already applied — the
+    supervisor derives it once so every respawn serves identical config).
+    ``conn`` carries the ready handshake; it stays open afterward purely so
+    an EOF can tell this worker the supervisor vanished.
+    """
+    # Spawned children re-run sitecustomize, which may re-force a hardware
+    # platform via jax.config; re-assert the env's platform choice before
+    # any backend init (mirrors tpuserve.deferred._worker_run).
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import asyncio
+    import logging
+
+    from tpuserve.server import ServerState, configure_logging, serve_async
+
+    configure_logging(cfg)
+    logging.getLogger("tpuserve.workerproc").info(
+        "worker %d: building models (pid %d)", worker_id, os.getpid())
+    try:
+        state = ServerState(cfg)
+        state.worker_id = worker_id
+        state.build()
+    except Exception as e:  # noqa: BLE001 — report any boot death upward
+        try:
+            conn.send({"op": "died", "error": f"{type(e).__name__}: {e}"})
+        finally:
+            conn.close()
+        raise
+
+    async def _serve() -> None:
+        loop = asyncio.get_running_loop()
+        ready = asyncio.Event()
+        serve_task = loop.create_task(serve_async(state, ready))
+        ready_task = loop.create_task(ready.wait())
+        # First of: listener up (-> handshake) or an early serve failure
+        # (port bind, startup canary) — the latter must surface as a
+        # "died" message, not a supervisor handshake timeout.
+        await asyncio.wait({serve_task, ready_task},
+                           return_when=asyncio.FIRST_COMPLETED)
+        if serve_task.done():
+            ready_task.cancel()
+            serve_task.result()  # raises the boot failure
+            return
+        conn.send({"op": "ready", "port": state.serving_addresses[0][1],
+                   "pid": os.getpid()})
+        await serve_task
+
+    try:
+        asyncio.run(_serve())
+    except Exception as e:  # noqa: BLE001 — report any death upward
+        try:
+            conn.send({"op": "died", "error": f"{type(e).__name__}: {e}"})
+        except (BrokenPipeError, OSError):
+            pass
+        raise
+    finally:
+        conn.close()
