@@ -238,8 +238,222 @@ def print_breakdown(state, header: str) -> None:
             print(f"#   {name} pool: {d['stats']}", file=sys.stderr)
 
 
+def _gen_model_config(bench_model: str):
+    """The generative model served by BENCH_MODEL=textgen|sd15 (ISSUE 9).
+    Sizes are the family defaults (env-overridable); buckets size the
+    engine's slot block via [genserve] slots = 0."""
+    from tpuserve.config import ModelConfig
+
+    slots = int(env_f("BENCH_GEN_SLOTS", 8))
+    if bench_model == "textgen":
+        return ModelConfig(
+            name="textgen", family="textgen",
+            batch_buckets=[1, max(2, slots // 2), slots],
+            dtype="bfloat16", parallelism="single",
+            request_timeout_ms=120_000.0,
+            options=dict(
+                layers=int(env_f("BENCH_GEN_LAYERS", 4)),
+                d_model=int(env_f("BENCH_GEN_DMODEL", 256)),
+                prompt_len=int(env_f("BENCH_GEN_PROMPT", 32)),
+                max_new_tokens=int(env_f("BENCH_GEN_MAX_NEW", 64)),
+                attention=os.environ.get("BENCH_GEN_ATTENTION", "dense"),
+            ))
+    return ModelConfig(
+        name="sd15", family="sd15", batch_buckets=[1, max(2, slots)],
+        dtype="bfloat16", parallelism="single",
+        image_size=int(env_f("BENCH_SD_IMAGE", 512)),
+        request_timeout_ms=600_000.0,
+        options=dict(steps=int(env_f("BENCH_SD_STEPS", 20))))
+
+
+async def _run_gen_load(cfg, model: str, duration: float, warmup: float,
+                        concurrency: int, distinct: int, synth: str,
+                        max_new_hi: int) -> dict:
+    """Out-of-process mixed-length prompt load against a running server."""
+    args = [
+        sys.executable, "-m", "tpuserve", "bench",
+        "--url", f"http://{cfg.host}:{cfg.port}",
+        "--model", model, "--verb", "generate",
+        "--duration", str(duration), "--warmup", str(warmup),
+        "--concurrency", str(concurrency),
+        "--content-type", "application/json",
+        "--distinct", str(distinct), "--synthetic", synth,
+        "--max-new", f"2,{max_new_hi}",
+    ]
+    proc = await asyncio.create_subprocess_exec(
+        *args, stdout=asyncio.subprocess.PIPE,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out, _ = await proc.communicate()
+    return json.loads(out.decode())
+
+
+def main_generative(bench_model: str) -> int:
+    """BENCH_MODEL=textgen|sd15: the generative headline (ISSUE 9).
+
+    Two passes over the SAME mixed-output-length prompt pool:
+
+    1. **engine** — [genserve] on: iteration-level continuous batching
+       (finished sequences exit early, queued work folds in mid-flight).
+       Headline = tokens/s (textgen) or images/min (sd15), computed from
+       the server's ``gen_units_total`` delta — counting requests would
+       hide the mixed lengths the engine exists for.
+    2. **locked** — the same model through the static batcher: every lane
+       pays the full generation loop (textgen's fori_loop cap / the
+       one-executable denoise). ``speedup_vs_locked`` is the iteration-
+       level scheduling gain at this workload mix.
+
+    The roofline block attributes PER-ITERATION phases (insert = prefill/
+    encode, step = one decode/denoise iteration, extract = tail decode)
+    from the engine's gen_*_ms histograms."""
+    import jax
+
+    from tpuserve.config import GenserveConfig, ServerConfig
+    from tpuserve.server import ServerState, make_app
+
+    t_all = time.time()
+    duration = env_f("BENCH_DURATION", 20)
+    warmup = env_f("BENCH_WARMUP", 4)
+    concurrency = int(env_f("BENCH_CONCURRENCY", 16))
+    distinct = int(env_f("BENCH_DISTINCT", 64))
+    slots = int(env_f("BENCH_GEN_SLOTS", 8))
+    synth = "prompt" if bench_model == "textgen" else "sd-prompt"
+    mcfg = _gen_model_config(bench_model)
+    max_new_hi = int(mcfg.options.get("max_new_tokens", 64)) \
+        if bench_model == "textgen" else 0
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jaxcache")
+
+    async def one_pass(genserve_on: bool) -> tuple[dict, dict, "ServerState"]:
+        from aiohttp import web
+
+        cfg = ServerConfig(
+            host="127.0.0.1", port=int(os.environ.get("BENCH_PORT", 18321)),
+            decode_threads=4, startup_canary=False,
+            decode_inline=bool(int(os.environ.get("BENCH_DECODE_INLINE", "1"))),
+            compilation_cache_dir=cache_dir,
+            genserve=GenserveConfig(enabled=genserve_on, slots=slots),
+            models=[_gen_model_config(bench_model)])
+        state = ServerState(cfg)
+        t0 = time.time()
+        state.build()
+        print(f"# {'engine' if genserve_on else 'locked'} build took "
+              f"{time.time() - t0:.1f}s", file=sys.stderr)
+        runner = web.AppRunner(make_app(state), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, cfg.host, cfg.port)
+        await site.start()
+        name = cfg.models[0].name
+        try:
+            u0 = state.metrics.counter(
+                f"gen_units_total{{model={name}}}").value
+            i0 = state.metrics.counter(f"items_total{{model={name}}}").value
+            res = await _run_gen_load(cfg, name, duration, warmup,
+                                      concurrency, distinct, synth,
+                                      max_new_hi)
+            counters = {
+                "units": state.metrics.counter(
+                    f"gen_units_total{{model={name}}}").value - u0,
+                "items": state.metrics.counter(
+                    f"items_total{{model={name}}}").value - i0,
+            }
+            summary = state.metrics.summary()
+            print_breakdown(state, "engine" if genserve_on else "locked")
+            return res, {"counters": counters, "summary": summary}, state
+        finally:
+            await runner.cleanup()
+
+    async def run() -> dict:
+        eng_res, eng_side, eng_state = await one_pass(True)
+        if eng_res.get("n_err"):
+            print(f"# engine pass errors: {eng_res}", file=sys.stderr)
+        # Output units per request from the engine pass's own server-side
+        # accounting (the pool mixes lengths, so a constant would lie).
+        c = eng_side["counters"]
+        units_per_req = c["units"] / c["items"] if c["items"] else 0.0
+        eng_rps = eng_res["throughput_per_s"]
+        eng_units_s = eng_rps * units_per_req
+
+        locked = None
+        if int(env_f("BENCH_GEN_BASELINE", 1)):
+            locked_res, _locked_side, _ = await one_pass(False)
+            locked = {
+                "requests_per_s": locked_res["throughput_per_s"],
+                "p50_ms": locked_res["p50_ms"],
+                "p99_ms": locked_res["p99_ms"],
+                "n_err": locked_res["n_err"],
+            }
+
+        lat = eng_side["summary"]["latency"]
+        name = bench_model if bench_model == "textgen" else "sd15"
+
+        def p50(metric: str):
+            row = lat.get(f"{metric}{{model={name}}}")
+            return round(row["p50_ms"], 3) if row else None
+
+        gs = eng_state.engines[name].pipeline_stats()
+        if bench_model == "textgen":
+            metric, value, unit = "textgen_tokens_s", eng_units_s, "tok/s"
+        else:
+            metric, value, unit = "sd15_images_min", eng_units_s * 60.0, "img/min"
+        line = {
+            "metric": metric,
+            "value": round(value, 2),
+            "unit": unit,
+            "requests_per_s": round(eng_rps, 2),
+            "units_per_request": round(units_per_req, 2),
+            "p50_ms": eng_res["p50_ms"],
+            "p99_ms": eng_res["p99_ms"],
+            "n_err": eng_res["n_err"],
+            "mixed_lengths": {"distinct": distinct,
+                              "max_new_range": [2, max_new_hi]
+                              if max_new_hi else None},
+            "genserve": {
+                "slots": slots,
+                "iterations_total": gs["iterations_total"],
+                "fold_ins_total": gs["fold_ins_total"],
+                "early_exits_total": gs["early_exits_total"],
+                "evictions_total": gs["evictions_total"],
+            },
+            # Per-iteration phase attribution (the gen roofline): what one
+            # admission (prefill/encode), one iteration, and one tail
+            # extract cost at p50 on this config.
+            "roofline": {
+                "insert_ms_p50": p50("gen_insert_ms"),
+                "step_ms_p50": p50("gen_step_ms"),
+                "extract_ms_p50": p50("gen_extract_ms"),
+                "steps_per_request_ewma": gs["iters_per_request_ewma"],
+            },
+            "locked_batch": locked,
+            "speedup_vs_locked": round(
+                eng_rps / locked["requests_per_s"], 2)
+            if locked and locked["requests_per_s"] else None,
+            "backend": {
+                "platform": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "jax_version": jax.__version__,
+            },
+            "config": {"model": bench_model, "duration_s": duration,
+                       "concurrency": concurrency,
+                       "options": dict(mcfg.options)},
+            "wall_s": round(time.time() - t_all, 1),
+        }
+        return line
+
+    line = asyncio.run(run())
+    print(json.dumps(line))
+    return 0 if line["n_err"] == 0 and line["value"] > 0 else 1
+
+
 def main() -> int:
     t_all = time.time()
+    bench_model = os.environ.get("BENCH_MODEL", "")
+    if bench_model:
+        if bench_model not in ("textgen", "sd15"):
+            print(f"# unknown BENCH_MODEL={bench_model!r}; "
+                  "use textgen|sd15 or unset", file=sys.stderr)
+            return 2
+        return main_generative(bench_model)
     mode = os.environ.get("BENCH_MODE", "direct")
     wire_format = os.environ.get("BENCH_WIRE_FORMAT", "yuv420")
     wire = int(env_f("BENCH_WIRE", 160))

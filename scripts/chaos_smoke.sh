@@ -32,6 +32,9 @@ scripts/cache_smoke.sh
 echo "== roofline smoke (variant registry / zero recompiles / compute split) =="
 scripts/roofline_smoke.sh
 
+echo "== genserve smoke (mixed-length load, early exits + fold-ins, compile delta 0) =="
+scripts/genserve_smoke.sh
+
 echo "== multichip smoke (8 replicas all serving / sharded mesh / reload mid-load) =="
 scripts/multichip_smoke.sh
 

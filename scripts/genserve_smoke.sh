@@ -1,0 +1,126 @@
+#!/usr/bin/env bash
+# Generation-engine smoke (ISSUE 9): a short closed loop of MIXED-length
+# generative load through the REAL server on the CPU backend proving the
+# iteration-level engine end to end:
+#   1. zero errors under sustained mixed-length prompt load;
+#   2. the continuous-batching counters move: gen_early_exits_total > 0
+#      (short sequences retire while longer ones keep running) and
+#      gen_fold_ins_total > 0 (queued requests join a mid-flight block);
+#   3. steady state recompiles NOTHING: the runtime_compiles_total delta
+#      across warm load + a :reload publish (which runs the engine's
+#      staged canary — a short real generation) is exactly 0;
+#   4. the /stats genserve block is well-formed and the slot ledger is
+#      exactly balanced after drain (active 0, free = slots).
+# Run by CI next to the chaos/reload/pipeline/cache/roofline drills; see
+# docs/PERFORMANCE.md "The generation engine".
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): the engine's step
+# loop is deliberately lock-free (event-loop-only state), so the witness
+# proves no stage-executor path holds a lock across an await either.
+export TPUSERVE_LOCK_WITNESS=1
+
+python - <<'EOF'
+import asyncio
+
+import aiohttp
+from aiohttp import web
+
+from tpuserve.bench.loadgen import run_load, synthetic_prompt_pool
+from tpuserve.config import GenserveConfig, ModelConfig, ServerConfig
+from tpuserve.server import ServerState, make_app
+
+cfg = ServerConfig(
+    decode_threads=2,
+    startup_canary=False,
+    genserve=GenserveConfig(enabled=True, slots=4),
+    models=[ModelConfig(
+        name="textgen", family="textgen", batch_buckets=[1, 2, 4],
+        dtype="float32", parallelism="single",
+        request_timeout_ms=60_000.0,
+        options=dict(layers=1, d_model=64, heads=2, d_ff=128,
+                     vocab_size=512, prompt_len=16, max_new_tokens=32),
+    )],
+)
+
+
+async def scrape(base: str, session) -> tuple[dict, dict]:
+    async with session.get(f"{base}/metrics") as r:
+        text = await r.text()
+    metrics = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        k, v = line.rsplit(" ", 1)
+        try:
+            metrics[k] = float(v)
+        except ValueError:
+            pass
+    async with session.get(f"{base}/stats") as r:
+        stats = await r.json()
+    return metrics, stats
+
+
+async def main() -> None:
+    state = ServerState(cfg)
+    state.build()
+    runner = web.AppRunner(make_app(state), access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    base = f"http://127.0.0.1:{runner.addresses[0][1]}"
+    # MIXED output lengths are the point: short completions must exit
+    # early past long ones for the engine counters to move.
+    pool = synthetic_prompt_pool(32, max_new=(2, 32))
+    url = f"{base}/v1/models/textgen:generate"
+    try:
+        res = await run_load(url, pool, "application/json",
+                             duration_s=2.0, warmup_s=0.5, concurrency=8)
+        assert res.n_err == 0 and res.n_ok > 0, res.summary()
+        async with aiohttp.ClientSession() as s:
+            m0, _ = await scrape(base, s)
+            res2 = await run_load(url, pool, "application/json",
+                                  duration_s=2.0, warmup_s=0.0,
+                                  concurrency=8)
+            assert res2.n_err == 0 and res2.n_ok > 0, res2.summary()
+            # Reload mid-steady-state: the engine's staged canary runs a
+            # short REAL generation against the candidate, and the publish
+            # must not compile anything.
+            async with s.post(f"{base}/admin/models/textgen:reload") as r:
+                body = await r.json()
+                assert r.status == 200, body
+                assert body["canary_ok"] is True, body
+            res3 = await run_load(url, pool, "application/json",
+                                  duration_s=1.0, warmup_s=0.0,
+                                  concurrency=8)
+            assert res3.n_err == 0 and res3.n_ok > 0, res3.summary()
+            m1, stats = await scrape(base, s)
+
+        key = 'runtime_compiles_total{model="textgen"}'
+        assert m0.get(key, 0) >= 3, f"gen programs not registered: {m0}"
+        delta = m1.get(key, 0) - m0.get(key, 0)
+        assert delta == 0, f"steady state recompiled: delta={delta}"
+        early = m1.get('gen_early_exits_total{model="textgen"}', 0)
+        folds = m1.get('gen_fold_ins_total{model="textgen"}', 0)
+        iters = m1.get('gen_iterations_total{model="textgen"}', 0)
+        assert early > 0, f"no early exits under mixed lengths: {m1}"
+        assert folds > 0, f"no mid-flight fold-ins: {m1}"
+        assert iters > 0
+        gs = stats["genserve"]["textgen"]
+        assert gs["mode"] == "genserve" and gs["slots"] == 4, gs
+        assert gs["active"] == 0 and gs["free"] == 4, gs  # ledger balanced
+        assert gs["step_ewma_ms"] and gs["step_ewma_ms"] > 0, gs
+        served = [v for k, v in m1.items()
+                  if k.startswith("runtime_variant_batches_total") and v > 0]
+        assert served, f"no gen program serving counters moved: {m1}"
+        print(f"genserve smoke OK: {res2.throughput:.1f} req/s, "
+              f"compiles delta 0 (total {m1[key]:.0f}), "
+              f"early_exits {early:.0f}, fold_ins {folds:.0f}, "
+              f"iterations {iters:.0f}")
+    finally:
+        await runner.cleanup()
+
+
+asyncio.run(main())
+EOF

@@ -1,0 +1,711 @@
+"""Iteration-level continuous batching (ISSUE 9): scheduler invariants,
+slot-arena safety, deadline eviction mid-generation, zero recompiles across
+admit/retire/reload churn, engine-vs-locked-batch parity, the generative
+cache-key contract, and the HTTP front door (textgen + SD 1.5 through the
+engine). docs/PERFORMANCE.md "The generation engine"."""
+
+import asyncio
+import json
+
+import pytest
+
+from tpuserve.batcher import DeadlineExceeded, QueueFull
+from tpuserve.config import (GenserveConfig, ModelConfig, ServerConfig,
+                             load_config)
+from tpuserve.genserve import GenEngine, SlotArena, SlotCorrupted, SlotInfo
+from tpuserve.models import build
+from tpuserve.obs import Metrics
+from tpuserve.runtime import build_runtime
+
+TG_OPTS = dict(layers=1, d_model=32, heads=2, d_ff=64, vocab_size=512,
+               prompt_len=16, max_new_tokens=64)
+
+
+def tg_cfg(**over) -> ModelConfig:
+    base = dict(name="tg", family="textgen", batch_buckets=[1, 2, 4],
+                dtype="float32", parallelism="single", max_queue=64,
+                request_timeout_ms=60_000.0, options=dict(TG_OPTS))
+    base.update(over)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tg_rt():
+    """One compiled textgen model+runtime for every engine test (the three
+    gen programs compile once; engines over it are cheap)."""
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    eng = GenEngine(model, rt, Metrics(), GenserveConfig(slots=4))
+    eng.compile()
+    return model, rt
+
+
+def make_engine(tg_rt, metrics=None, slots=4, **gc_over):
+    model, rt = tg_rt
+    m = metrics or Metrics()
+    eng = GenEngine(model, rt, m, GenserveConfig(slots=slots, **gc_over))
+    eng.compile()  # reuses the runtime's registered programs
+    return eng, m
+
+
+def prompt_item(model, prompt="hello world", seed=0, max_new=8, temp=0.0):
+    body = {"prompt": prompt, "seed": seed, "max_new_tokens": max_new}
+    if temp:
+        body["temperature"] = temp
+    return model.host_decode(json.dumps(body).encode(), "application/json")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# SlotArena: never double-hands
+# ---------------------------------------------------------------------------
+
+def test_slot_arena_never_double_hands():
+    a = SlotArena(2)
+    s0 = a.acquire(SlotInfo(item=None, future=None))
+    s1 = a.acquire(SlotInfo(item=None, future=None))
+    assert {s0, s1} == {0, 1} and a.n_free == 0
+    with pytest.raises(IndexError):
+        a.acquire(SlotInfo(item=None, future=None))
+    a.release(s0)
+    with pytest.raises(SlotCorrupted):
+        a.release(s0)  # double release
+    # A corrupted free-list (same slot twice) is caught at acquire.
+    a._free.append(s1)
+    with pytest.raises(SlotCorrupted):
+        a.acquire(SlotInfo(item=None, future=None))
+
+
+def test_slot_arena_release_all():
+    a = SlotArena(3)
+    infos = [a.acquire(SlotInfo(item=i, future=None)) for i in range(3)]
+    assert len(infos) == 3
+    out = a.release_all()
+    assert [i.item for i in out] == [0, 1, 2]
+    assert a.n_free == 3 and a.n_active == 0
+
+
+# ---------------------------------------------------------------------------
+# Scheduler invariants
+# ---------------------------------------------------------------------------
+
+def test_short_after_long_finishes_first(tg_rt):
+    """THE continuous-batching property: a 2-token request admitted AFTER a
+    60-token one completes FIRST — a locked batch would hold it hostage."""
+    model, _ = tg_rt
+    eng, _m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        order = []
+        long_f = eng.submit(prompt_item(model, "long", seed=1, max_new=60))
+        long_f.add_done_callback(lambda f: order.append("long"))
+        await asyncio.sleep(0.02)  # the long one is mid-generation now
+        short_f = eng.submit(prompt_item(model, "short", seed=2, max_new=2))
+        short_f.add_done_callback(lambda f: order.append("short"))
+        rl, rs = await asyncio.gather(long_f, short_f)
+        await eng.stop()
+        assert order == ["short", "long"], order
+        assert rl["n_tokens"] == 60 and rs["n_tokens"] == 2
+
+    run(go())
+
+
+def test_fold_in_and_early_exit_counters(tg_rt):
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        long_f = eng.submit(prompt_item(model, "marathon", seed=3, max_new=60))
+        await asyncio.sleep(0.02)
+        shorts = [eng.submit(prompt_item(model, f"s{i}", seed=10 + i,
+                                         max_new=2)) for i in range(3)]
+        await asyncio.gather(long_f, *shorts)
+        await eng.stop()
+        assert m.counter("gen_fold_ins_total{model=tg}").value >= 3
+        assert m.counter("gen_early_exits_total{model=tg}").value >= 3
+        assert m.counter("gen_iterations_total{model=tg}").value > 0
+
+    run(go())
+
+
+def test_engine_matches_locked_batch_tokens(tg_rt):
+    """Engine path == locked-batch forward path, token for token: both
+    share _prefill/_decode_step and the positional (seed, position)
+    sampling fold, so identical requests are bit-identical across the two
+    schedulers (and across batch compositions)."""
+    model, _ = tg_rt
+    eng, _m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        res = await eng.submit(prompt_item(model, "parity check run",
+                                           seed=5, max_new=17))
+        await eng.stop()
+        return res
+
+    engine_res = run(go())
+    rt2 = build_runtime(model)  # forward buckets (the locked path)
+    item = prompt_item(model, "parity check run", seed=5, max_new=17)
+    out = rt2.fetch(rt2.run((1,), model.assemble([item], (1,))))
+    locked = model.host_postprocess(out, 1)[0]
+    assert locked["tokens"] == engine_res["tokens"]
+    assert locked["n_tokens"] == 17
+
+
+def test_deadline_eviction_mid_generation(tg_rt):
+    """A request whose deadline lands mid-generation 504s at the stamped
+    instant (within one iteration of it) and frees its slot for queued
+    work. Iterations are chaos-slowed to 10 ms so the 60-token run
+    provably spans the 80 ms deadline on any host speed."""
+    import time
+
+    from tpuserve.faults import FaultInjector
+
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+    eng.injector = FaultInjector.single("slow_dispatch", delay_ms=10.0)
+
+    async def go():
+        await eng.start()
+        t0 = time.perf_counter()
+        doomed = eng.submit(prompt_item(model, "doomed", seed=6, max_new=60),
+                            deadline_at=t0 + 0.08)
+        with pytest.raises(DeadlineExceeded):
+            await doomed
+        elapsed = time.perf_counter() - t0
+        # At the stamped instant (within ~one slowed iteration), not at
+        # generation end: 60 iterations x 10 ms would be >= 600 ms.
+        assert 0.08 <= elapsed < 0.4, elapsed
+        assert m.counter("gen_evictions_total{model=tg}").value == 1
+        assert m.counter("deadline_exceeded_total{model=tg}").value == 1
+        # The freed slot serves the next request.
+        eng.injector = None
+        ok = await eng.submit(prompt_item(model, "alive", seed=7, max_new=2))
+        assert ok["n_tokens"] == 2
+        await eng.stop()
+
+    run(go())
+
+
+def test_queued_deadline_expires_without_admission(tg_rt):
+    """Deadline already expired while queued -> fast 504, never admitted."""
+    import time
+
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        fut = eng.submit(prompt_item(model, "late", seed=8, max_new=4),
+                         deadline_at=time.perf_counter() - 0.001)
+        with pytest.raises(DeadlineExceeded):
+            await fut
+        assert m.counter("gen_admitted_total{model=tg}").value == 0
+        await eng.stop()
+
+    run(go())
+
+
+def test_zero_recompiles_across_churn_and_reload(tg_rt):
+    """The acceptance bar: sustained admit/retire churn with mixed lengths,
+    plus a publish AND a rollback mid-churn, with runtime_compiles_total
+    delta exactly 0 — slot churn and version churn reuse the registered
+    step/insert/extract programs."""
+    model, rt = tg_rt
+    eng, _m = make_engine(tg_rt)
+    c0 = rt.compiles_total
+    assert c0 >= 3  # insert/step/extract registered
+
+    async def go():
+        await eng.start()
+        futs = [eng.submit(prompt_item(model, f"p{i}", seed=i,
+                                       max_new=2 + (i % 9)))
+                for i in range(8)]
+        rt.publish(rt.stage_params())  # reload mid-churn
+        futs += [eng.submit(prompt_item(model, f"q{i}", seed=100 + i,
+                                        max_new=2 + (i % 5)))
+                 for i in range(8)]
+        rt.rollback()
+        futs += [eng.submit(prompt_item(model, f"r{i}", seed=200 + i,
+                                        max_new=3)) for i in range(4)]
+        res = await asyncio.gather(*futs)
+        await eng.stop()
+        return res
+
+    res = run(go())
+    assert len(res) == 20 and all(r["n_tokens"] >= 1 for r in res)
+    assert rt.compiles_total == c0, (rt.compiles_total, c0)
+    # Slot accounting survived the churn exactly.
+    assert eng.arena.n_active == 0 and eng.arena.n_free == eng.slots
+
+
+def test_queue_full_sheds(tg_rt):
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+    eng.cfg.max_queue = 2
+
+    async def go():
+        await eng.start()
+        try:
+            # Not yet admitted: the loop hasn't run between submits.
+            eng.submit(prompt_item(model, "a", max_new=2))
+            eng.submit(prompt_item(model, "b", max_new=2))
+            with pytest.raises(QueueFull):
+                eng.submit(prompt_item(model, "c", max_new=2))
+            assert m.counter("shed_total{model=tg}").value == 1
+        finally:
+            eng.cfg.max_queue = 64
+            await eng.stop()
+
+    run(go())
+
+
+def test_cancelled_request_frees_slot(tg_rt):
+    model, _ = tg_rt
+    eng, _m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        fut = eng.submit(prompt_item(model, "gone", seed=9, max_new=60))
+        await asyncio.sleep(0.02)
+        assert eng.arena.n_active >= 1
+        fut.cancel()
+        ok = await eng.submit(prompt_item(model, "here", seed=10, max_new=2))
+        assert ok["n_tokens"] == 2
+        # The cancelled slot was reaped by the loop.
+        for _ in range(50):
+            if eng.arena.n_active == 0:
+                break
+            await asyncio.sleep(0.01)
+        assert eng.arena.n_active == 0
+        await eng.stop()
+
+    run(go())
+
+
+def test_step_failure_contained_and_loop_survives(tg_rt):
+    """An injected step failure fails the in-flight set with the cause,
+    resets the state block, and the engine keeps serving."""
+    from tpuserve.faults import FaultInjected, FaultInjector
+
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        eng.injector = FaultInjector.single("batch_error", count=1)
+        with pytest.raises(FaultInjected):
+            await eng.submit(prompt_item(model, "boom", seed=11, max_new=8))
+        assert m.counter("batch_errors_total{model=tg}").value == 1
+        ok = await eng.submit(prompt_item(model, "fine", seed=12, max_new=3))
+        assert ok["n_tokens"] == 3
+        eng.injector = None
+        await eng.stop()
+
+    run(go())
+
+
+def test_watchdog_revives_dead_step_loop(tg_rt):
+    from tpuserve.faults import FaultInjector
+
+    model, _ = tg_rt
+    eng, _m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        eng.injector = FaultInjector.single("kill_group_loop", count=1)
+        fut = eng.submit(prompt_item(model, "stalled", seed=13, max_new=2))
+        for _ in range(100):
+            if eng._loop_task.done():
+                break
+            await asyncio.sleep(0.01)
+        assert eng._loop_task.done()  # chaos killed the loop
+        eng.injector = None
+        assert eng.revive_group_loops() == 1
+        res = await asyncio.wait_for(fut, timeout=10)
+        assert res["n_tokens"] == 2
+        assert eng.revive_group_loops() == 0  # healthy loop: no-op
+        await eng.stop()
+
+    run(go())
+
+
+def test_drain_waits_for_mid_generation_work(tg_rt):
+    model, _ = tg_rt
+    eng, _m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        fut = eng.submit(prompt_item(model, "draining", seed=14, max_new=20))
+        await asyncio.sleep(0.02)
+        loop = asyncio.get_running_loop()
+        ok = await eng.drain(loop.time() + 30.0)
+        assert ok and fut.done() and (await fut)["n_tokens"] == 20
+        await eng.stop()
+
+    run(go())
+
+
+def test_staged_canary_runs_short_generation(tg_rt):
+    """The lifecycle's staged-canary hook: a candidate tree proves itself
+    on a real end-to-end generation without touching the live state."""
+    model, rt = tg_rt
+    eng, _m = make_engine(tg_rt)
+    staged = rt.stage_params()
+    eng.staged_canary_sync(staged)  # must not raise
+    c0 = rt.compiles_total
+    eng.staged_canary_sync(staged)
+    assert rt.compiles_total == c0  # canaries never compile
+
+
+def test_flash_prefill_matches_dense(tg_rt):
+    """options.attention='flash' routes the bidirectional prompt prefill
+    through the seeded Pallas kernel; greedy token streams must match the
+    dense twin exactly (same seeded weights)."""
+    model_d, _ = tg_rt
+    model_f = build(tg_cfg(options={**TG_OPTS, "attention": "flash"}))
+    rt_d = build_runtime(model_d)
+    rt_f = build_runtime(model_f)
+    item = prompt_item(model_d, "flash parity prompt", seed=21, max_new=9)
+    out_d = rt_d.fetch(rt_d.run((1,), model_d.assemble([item], (1,))))
+    out_f = rt_f.fetch(rt_f.run((1,), model_f.assemble([item], (1,))))
+    res_d = model_d.host_postprocess(out_d, 1)[0]
+    res_f = model_f.host_postprocess(out_f, 1)[0]
+    assert res_d["tokens"] == res_f["tokens"]
+
+
+def test_textgen_option_validation():
+    with pytest.raises(ValueError, match="attention"):
+        build(tg_cfg(options={**TG_OPTS, "attention": "magic"}))
+    with pytest.raises(ValueError, match="divisible by 8"):
+        build(tg_cfg(options={**TG_OPTS, "attention": "flash",
+                              "prompt_len": 12}))
+    with pytest.raises(ValueError, match="heads"):
+        build(tg_cfg(options={**TG_OPTS, "d_model": 33}))
+
+
+# ---------------------------------------------------------------------------
+# Generative cache-key contract (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_generation_cache_keys_include_sampling_params(tg_rt):
+    """Two prompts differing ONLY in seed / temperature / max_new_tokens
+    digest to distinct cache keys — the item carries every sampling param,
+    so aliasing is structurally impossible."""
+    from tpuserve.cache import item_digest
+
+    model, _ = tg_rt
+    base = prompt_item(model, "same prompt", seed=1, max_new=8)
+    digests = {
+        item_digest(base),
+        item_digest(prompt_item(model, "same prompt", seed=2, max_new=8)),
+        item_digest(prompt_item(model, "same prompt", seed=1, max_new=9)),
+        item_digest(prompt_item(model, "same prompt", seed=1, max_new=8,
+                                temp=0.7)),
+    }
+    assert len(digests) == 4
+    # And identical params digest identically (the hit path exists).
+    assert item_digest(base) == item_digest(
+        prompt_item(model, "same prompt", seed=1, max_new=8))
+
+
+def test_sd15_cache_keys_include_seed():
+    from tpuserve.cache import item_digest
+    from tpuserve.models import build as mbuild
+
+    sd = mbuild(ModelConfig(
+        name="sd", family="sd15", batch_buckets=[1], dtype="float32",
+        parallelism="single", image_size=32,
+        options=dict(steps=2, vocab_size=128, text_layers=1, text_d_model=16,
+                     text_heads=2, unet_ch=8, unet_mults=[1, 2], unet_res=1,
+                     unet_attn_levels=[0], unet_heads=2, vae_ch=8,
+                     vae_mults=[1, 2])))
+    a = sd.host_decode(b'{"prompt": "x", "seed": 1}', "application/json")
+    b = sd.host_decode(b'{"prompt": "x", "seed": 2}', "application/json")
+    assert item_digest(a) != item_digest(b)
+
+
+def test_cacheable_false_skips_server_cache():
+    from tpuserve.config import CacheConfig
+    from tpuserve.server import ServerState
+
+    cfg = ServerConfig(
+        decode_threads=2, startup_canary=False,
+        cache=CacheConfig(enabled=True),
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            dtype="float32", num_classes=10,
+                            parallelism="single", cacheable=False)])
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        await state.start()
+        try:
+            # cacheable=false: no ModelCache built despite [cache] enabled.
+            assert state.caches == {}
+        finally:
+            await state.stop()
+
+    run(go())
+
+
+def test_cacheable_false_skips_router_cache():
+    """Router-side generation-key contract: the wire cache digests the raw
+    body (seed differences always split keys), and a cacheable=false model
+    gets NO router cache at all."""
+    from tpuserve.config import CacheConfig
+    from tpuserve.workerproc.router import RouterState
+
+    cfg = ServerConfig(
+        cache=CacheConfig(enabled=True),
+        models=[
+            ModelConfig(name="gen", family="textgen", cacheable=False),
+            ModelConfig(name="tg", family="textgen"),
+        ])
+    cfg.router.enabled = True
+    state = RouterState(cfg)
+    assert "gen" not in state.caches   # opted out
+    cache = state.caches["tg"]         # cacheable (params ride in the body)
+    k1 = cache.key_for(("generate", "application/json",
+                        b'{"prompt": "p", "seed": 1}'))
+    k2 = cache.key_for(("generate", "application/json",
+                        b'{"prompt": "p", "seed": 2}'))
+    assert k1 != k2
+
+
+def test_genserve_config_toml(tmp_path):
+    p = tmp_path / "g.toml"
+    p.write_text("""
+[genserve]
+enabled = true
+slots = 6
+admit_per_step = 2
+
+[[model]]
+name = "tg"
+family = "textgen"
+cacheable = false
+""")
+    cfg = load_config(str(p))
+    assert cfg.genserve.enabled and cfg.genserve.slots == 6
+    assert cfg.genserve.admit_per_step == 2
+    assert cfg.models[0].cacheable is False
+    with pytest.raises(ValueError, match="admit_per_step"):
+        GenserveConfig(admit_per_step=-1)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door through the engine
+# ---------------------------------------------------------------------------
+
+def _gen_server(**over):
+    from tpuserve.server import ServerState
+
+    base = dict(
+        decode_threads=2,
+        genserve=GenserveConfig(enabled=True, slots=4),
+        models=[ModelConfig(name="tg", family="textgen",
+                            batch_buckets=[1, 2, 4], dtype="float32",
+                            parallelism="single",
+                            request_timeout_ms=60_000.0,
+                            options=dict(TG_OPTS))])
+    base.update(over)
+    cfg = ServerConfig(**base)
+    state = ServerState(cfg)
+    state.build()
+    return state
+
+
+def test_http_textgen_through_engine():
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.server import make_app
+
+    state = _gen_server()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/models/tg:generate",
+                data=json.dumps({"prompt": "hello", "seed": 4,
+                                 "max_new_tokens": 6}),
+                headers={"Content-Type": "application/json"})
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["n_tokens"] == 6 and len(body["tokens"]) == 6
+            # Engine-served model: forward buckets were never compiled,
+            # only the three gen programs.
+            assert state.runtimes["tg"].compile_forward is False
+            variants = {tuple(v["bucket"]) for v in
+                        state.runtimes["tg"].variants_summary()}
+            assert variants == {("extract", 4), ("insert", 4), ("step", 4)}
+            # /stats carries the genserve block; /metrics the counters.
+            stats = await (await client.get("/stats")).json()
+            assert stats["genserve"]["tg"]["mode"] == "genserve"
+            assert stats["pipeline"]["models"]["tg"]["mode"] == "genserve"
+            metrics = await (await client.get("/metrics")).text()
+            assert 'gen_iterations_total{model="tg"}' in metrics
+            # Bad sampling params reject at decode (400), not mid-engine.
+            bad = await client.post(
+                "/v1/models/tg:generate",
+                data=json.dumps({"prompt": "x", "max_new_tokens": 10_000}),
+                headers={"Content-Type": "application/json"})
+            assert bad.status == 400
+            # Per-request deadline -> fast 504 through the engine, with
+            # iterations chaos-slowed so the generation provably outlives
+            # the 50 ms budget on any host.
+            from tpuserve.faults import FaultInjector
+
+            state.batchers["tg"].injector = FaultInjector.single(
+                "slow_dispatch", delay_ms=10.0)
+            try:
+                slow = await client.post(
+                    "/v1/models/tg:generate?timeout_ms=50",
+                    data=json.dumps({"prompt": "slow", "seed": 1,
+                                     "max_new_tokens": 64}),
+                    headers={"Content-Type": "application/json"})
+                assert slow.status == 504, await slow.text()
+            finally:
+                state.batchers["tg"].injector = None
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_http_reload_engine_staged_canary():
+    """:reload on an engine-served model runs the engine's staged canary
+    (a short real generation) and publishes with zero recompiles; an
+    injected regression rejects at the staged_canary gate with the old
+    version serving."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.faults import FaultInjector
+    from tpuserve.server import make_app
+
+    state = _gen_server()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            c0 = state.metrics.counter(
+                "runtime_compiles_total{model=tg}").value
+            r = await client.post("/admin/models/tg:reload")
+            assert r.status == 200, await r.text()
+            assert (await r.json())["version"] == 2
+            assert state.metrics.counter(
+                "runtime_compiles_total{model=tg}").value == c0
+            # Regressed candidate: rejected at the staged canary, v2 serves.
+            state.lifecycles["tg"].injector = FaultInjector.single(
+                "reload_regressed", count=1)
+            r2 = await client.post("/admin/models/tg:reload")
+            assert r2.status == 409, await r2.text()
+            assert (await r2.json())["stage"] == "staged_canary"
+            ok = await client.post(
+                "/v1/models/tg:generate",
+                data=json.dumps({"prompt": "still here", "seed": 2,
+                                 "max_new_tokens": 3}),
+                headers={"Content-Type": "application/json"})
+            assert ok.status == 200
+            assert state.runtimes["tg"].version == 2
+        finally:
+            state.lifecycles["tg"].injector = None
+            await client.close()
+
+    run(go())
+
+
+def test_http_cache_hits_generative(tg_rt):
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.config import CacheConfig
+    from tpuserve.server import make_app
+
+    state = _gen_server(cache=CacheConfig(enabled=True))
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            body = json.dumps({"prompt": "cache me", "seed": 7,
+                               "max_new_tokens": 4})
+            hdrs = {"Content-Type": "application/json"}
+            r1 = await client.post("/v1/models/tg:generate", data=body,
+                                   headers=hdrs)
+            b1 = await r1.read()
+            r2 = await client.post("/v1/models/tg:generate", data=body,
+                                   headers=hdrs)
+            assert await r2.read() == b1
+            c = state.caches["tg"].stats()
+            assert c["hits"] == 1 and c["misses"] == 1
+            # Seed change -> different key -> a second real generation.
+            r3 = await client.post(
+                "/v1/models/tg:generate",
+                data=json.dumps({"prompt": "cache me", "seed": 8,
+                                 "max_new_tokens": 4}), headers=hdrs)
+            assert r3.status == 200
+            assert state.caches["tg"].stats()["misses"] == 2
+        finally:
+            await client.close()
+
+    run(go())
+
+
+@pytest.mark.slow
+def test_http_sd15_through_engine():
+    """SD 1.5 (tiny variant) serves txt2img through the iteration-level
+    engine: PNG out, deterministic in (prompt, seed), per-slot step
+    counters visible in /stats."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.server import ServerState, make_app
+
+    cfg = ServerConfig(
+        decode_threads=2,
+        genserve=GenserveConfig(enabled=True, slots=2),
+        models=[ModelConfig(
+            name="sd", family="sd15", batch_buckets=[1, 2], dtype="float32",
+            parallelism="single", image_size=32,
+            request_timeout_ms=120_000.0,
+            options=dict(steps=3, guidance=5.0, vocab_size=512,
+                         text_layers=1, text_d_model=32, text_heads=2,
+                         unet_ch=16, unet_mults=[1, 2], unet_res=1,
+                         unet_attn_levels=[0], unet_heads=2, vae_ch=16,
+                         vae_mults=[1, 2]))])
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            hdrs = {"Content-Type": "application/json"}
+            body = json.dumps({"prompt": "a red fox", "seed": 7})
+            r1, r2 = await asyncio.gather(
+                client.post("/v1/models/sd:generate", data=body,
+                            headers=hdrs),
+                client.post("/v1/models/sd:generate",
+                            data=json.dumps({"prompt": "blue", "seed": 9}),
+                            headers=hdrs))
+            assert r1.status == 200 and r2.status == 200
+            png1 = await r1.read()
+            assert png1[:8] == b"\x89PNG\r\n\x1a\n"
+            assert r1.content_type == "image/png"
+            # Deterministic: same (prompt, seed) -> byte-identical PNG.
+            r1b = await client.post("/v1/models/sd:generate", data=body,
+                                    headers=hdrs)
+            assert await r1b.read() == png1
+            stats = await (await client.get("/stats")).json()
+            assert stats["genserve"]["sd"]["iterations_total"] > 0
+        finally:
+            await client.close()
+
+    run(go())

@@ -142,6 +142,30 @@ def test_synthetic_pool_distinct_bodies():
     assert np.load(io.BytesIO(batched[0])).shape == (4, 8, 8, 3)
 
 
+def test_synthetic_prompt_pool_mixed_lengths():
+    """Generative workload construction (ISSUE 9): every pooled body is a
+    distinct (prompt, seed) pair — the cache-key contract guarantees no
+    aliasing — and textgen pools spread max_new_tokens across the range so
+    the offered load has MIXED output lengths (the engine's early-exit
+    counters only move on mixed lengths)."""
+    import json
+
+    import pytest
+
+    from tpuserve.bench.loadgen import synthetic_prompt_pool
+
+    pool = synthetic_prompt_pool(16, max_new=(2, 24))
+    bodies = [json.loads(p) for p in pool]
+    assert len({b["seed"] for b in bodies}) == 16  # no key can alias
+    lens = [b["max_new_tokens"] for b in bodies]
+    assert min(lens) >= 2 and max(lens) <= 24
+    assert len(set(lens)) > 4  # genuinely mixed, not constant
+    sd = [json.loads(p) for p in synthetic_prompt_pool(4, sd=True)]
+    assert all("max_new_tokens" not in b for b in sd)  # fixed-steps txt2img
+    with pytest.raises(ValueError, match="max_new"):
+        synthetic_prompt_pool(4, max_new=(5, 2))
+
+
 def test_closed_loop_cycles_distinct_pool(loop):
     """A list payload round-robins across workers and is reported in the
     summary, so a bench JSON always shows the workload shape."""

@@ -124,6 +124,37 @@ def synthetic_pool(kind: str, n: int, edge: int = 256,
     return [gen(edge, seed=i) for i in range(n)]
 
 
+def synthetic_prompt_pool(n: int, max_new: tuple[int, int] = (2, 32),
+                          sd: bool = False, seed: int = 0) -> list[bytes]:
+    """``n`` distinct JSON prompt bodies for the generative families.
+
+    Every body carries a distinct (prompt, seed) pair — the generative
+    cache-key contract means no two of them can alias — and, for textgen
+    (``sd=False``), a ``max_new_tokens`` drawn across ``[lo, hi]`` so the
+    offered load has MIXED output lengths. Mixed lengths are the point
+    (ISSUE 9): a locked batch runs every lane for its longest member, so
+    the iteration-level engine's early-exit gain is only visible when
+    short and long completions share a batch. SD bodies (``sd=True``) omit
+    the length knob (fixed denoise steps) and vary prompt + seed only."""
+    rng = np.random.default_rng(seed)
+    words = ("fast serve model token image chip batch fox sky ocean "
+             "mountain river night day glass stone").split()
+    lo, hi = max_new
+    if not sd and (lo < 1 or hi < lo):
+        raise ValueError(f"max_new range must satisfy 1 <= lo <= hi, "
+                         f"got {max_new}")
+    out = []
+    for i in range(n):
+        prompt = " ".join(rng.choice(words, size=int(rng.integers(2, 8))))
+        body: dict = {"prompt": prompt, "seed": i}
+        if not sd:
+            # Deterministic spread over [lo, hi]: short and long lengths
+            # interleave however the pool is cycled.
+            body["max_new_tokens"] = int(lo + (i * 7919) % (hi - lo + 1))
+        out.append(json.dumps(body).encode())
+    return out
+
+
 def synthetic_image_jpeg(edge: int = 256, seed: int = 0, quality: int = 85) -> bytes:
     """A realistic photo-like JPEG (smooth gradients compress like photos)."""
     from PIL import Image
@@ -278,11 +309,20 @@ async def run_load_open(
 def run_loadgen_cli(args) -> int:
     batch = int(getattr(args, "batch", 0) or 0)
     distinct = int(getattr(args, "distinct", 0) or 0)
-    if distinct > 1:
+    synth = getattr(args, "synthetic", "npy")
+    if distinct > 1 and synth in ("prompt", "sd-prompt"):
+        # Generative workload: distinct (prompt, seed) bodies, mixed
+        # max_new_tokens for textgen (the engine's early-exit/fold-in
+        # counters only move when output lengths mix).
+        lo, hi = (int(x) for x in
+                  str(getattr(args, "max_new", "2,32")).split(","))
+        payload = synthetic_prompt_pool(distinct, (lo, hi),
+                                        sd=synth == "sd-prompt")
+    elif distinct > 1:
         # Miss-only workload: a pool of distinct synthetic bodies, cycled
         # round-robin (a pool larger than the server's cache capacity makes
         # every lookup an LRU miss).
-        payload = synthetic_pool(getattr(args, "synthetic", "npy"), distinct,
+        payload = synthetic_pool(synth, distinct,
                                  int(getattr(args, "edge", 256)), batch)
     elif args.payload:
         with open(args.payload, "rb") as f:

@@ -67,10 +67,20 @@ def main(argv: list[str] | None = None) -> int:
                               "miss-only workload for the result cache when "
                               "N exceeds its capacity; 0/1 repeats one "
                               "payload (hit-heavy once the cache is warm)")
-    p_bench.add_argument("--synthetic", choices=["npy", "jpeg"], default="npy",
-                         help="synthetic payload kind for --distinct pools")
+    p_bench.add_argument("--synthetic",
+                         choices=["npy", "jpeg", "prompt", "sd-prompt"],
+                         default="npy",
+                         help="synthetic payload kind for --distinct pools: "
+                              "npy/jpeg images, or JSON prompt bodies for "
+                              "the generative families (prompt = textgen "
+                              "with mixed max_new_tokens, sd-prompt = "
+                              "fixed-steps txt2img)")
     p_bench.add_argument("--edge", type=int, default=256,
                          help="synthetic payload image edge for --distinct")
+    p_bench.add_argument("--max-new", default="2,32",
+                         help="lo,hi range of max_new_tokens for "
+                              "--synthetic prompt pools (mixed output "
+                              "lengths; ISSUE 9)")
 
     p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
     p_imp.add_argument("--saved-model", required=True)

@@ -237,6 +237,35 @@ class AdaptiveConfig:
 
 
 @dataclass
+class GenserveConfig:
+    """Iteration-level generation engine (``[genserve]`` TOML;
+    tpuserve.genserve, docs/PERFORMANCE.md "The generation engine").
+
+    The static-bucket batcher locks a batch for its whole run — correct for
+    one-shot classifiers, wrong for multi-step generative work. With this
+    block enabled, models whose family implements the generative contract
+    (``tpuserve.genserve.GenerativeModel``: textgen, sd15) serve through an
+    iteration-level engine instead (Orca, PAPERS.md P4): the active batch
+    re-forms every model iteration, finished sequences retire immediately,
+    queued requests fold into free slots mid-flight, and past-deadline
+    sequences evict with the fast-504 contract. Non-generative models keep
+    the batcher regardless."""
+
+    enabled: bool = False
+    # Generative slot capacity per model (the compiled step batch width);
+    # 0 = the model's largest batch bucket.
+    slots: int = 0
+    # Max queued requests folded into free slots per iteration; 0 = fill
+    # every free slot (bounding it smooths per-iteration insert cost).
+    admit_per_step: int = 0
+
+    def __post_init__(self) -> None:
+        if self.slots < 0 or self.admit_per_step < 0:
+            raise ValueError(
+                "genserve.slots/admit_per_step must be >= 0")
+
+
+@dataclass
 class ParallelConfig:
     """Multi-chip serving plan (``[parallel]`` TOML; docs/PERFORMANCE.md
     "Serving on the mesh").
@@ -455,6 +484,14 @@ class ModelConfig:
     relay_epoch_ms: float = 2000.0
     # recycle mode: per-worker shared-memory batch slots (in-flight batches).
     relay_slots: int = 4
+    # Result-cache eligibility: False keeps this model out of every result
+    # cache (server-side ModelCache AND the router tier's wire-level cache).
+    # Generative families keep every sampling parameter (seed, temperature,
+    # max_new_tokens, steps) inside the decoded item, so two requests
+    # differing only in seed can never alias a cache key — set this False
+    # only for models that are genuinely nondeterministic in their input
+    # (e.g. unseeded sampling).
+    cacheable: bool = True
     # -- robustness (docs/ROBUSTNESS.md) ------------------------------------
     # One-shot batch retry: a failed dispatch re-assembles and re-runs the
     # batch once before failing its futures (absorbs transient device/worker
@@ -510,6 +547,11 @@ class ServerConfig:
     # Multi-chip serving plan: replica-per-chip vs sharded-batch over the
     # local mesh (docs/PERFORMANCE.md "Serving on the mesh").
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    # Iteration-level generation engine for generative families
+    # (docs/PERFORMANCE.md "The generation engine"). Off by default: the
+    # static-bucket batcher serves everything, including generative models
+    # as locked batches.
+    genserve: GenserveConfig = field(default_factory=GenserveConfig)
     # Router/worker process split: multi-process failure domains with
     # supervision + hedged retry (docs/ROBUSTNESS.md). Off by default.
     router: RouterConfig = field(default_factory=RouterConfig)
@@ -602,6 +644,7 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
     parallel_dict = raw.pop("parallel", None)
+    genserve_dict = raw.pop("genserve", None)
     router_dict = raw.pop("router", None)
     worker_dict = raw.pop("worker", None)
     faults_dict = raw.pop("faults", None)
@@ -615,6 +658,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
         cfg.distributed = _build(DistributedConfig, dist_dict)
     if parallel_dict is not None:
         cfg.parallel = _build(ParallelConfig, parallel_dict)
+    if genserve_dict is not None:
+        cfg.genserve = _build(GenserveConfig, genserve_dict)
     if router_dict is not None:
         cfg.router = _build(RouterConfig, router_dict)
     if worker_dict is not None:

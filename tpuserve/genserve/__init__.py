@@ -1,0 +1,20 @@
+"""Iteration-level generative serving (ISSUE 9; docs/PERFORMANCE.md "The
+generation engine").
+
+- :class:`~tpuserve.genserve.model.GenerativeModel` — the family contract:
+  ``init_state`` / ``step`` / ``is_finished`` / ``finalize`` (+ ``extract``)
+  decompose generation into slot-block device programs.
+- :class:`~tpuserve.genserve.arena.SlotArena` — host-side slot ledger
+  (never double-hands a slot).
+- :class:`~tpuserve.genserve.engine.GenEngine` — the step loop: re-forms
+  the active batch every model iteration, retires finished sequences
+  immediately, folds queued requests into free slots, evicts past-deadline
+  sequences with the fast-504 contract.
+"""
+
+from tpuserve.genserve.arena import SlotArena, SlotCorrupted, SlotInfo
+from tpuserve.genserve.engine import GenEngine
+from tpuserve.genserve.model import GenerativeModel
+
+__all__ = ["GenEngine", "GenerativeModel", "SlotArena", "SlotCorrupted",
+           "SlotInfo"]

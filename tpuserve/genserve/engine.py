@@ -1,0 +1,580 @@
+"""Iteration-level continuous batching engine (ISSUE 9; Orca, PAPERS.md P4).
+
+The static-bucket batcher (tpuserve.batcher) locks a batch for its whole
+run: correct for one-shot ResNet/BERT, wrong for multi-step generative work
+where a 2-token completion admitted behind a 200-token one waits for both.
+This engine is the second dispatch path, scheduling at MODEL-ITERATION
+granularity over a fixed block of generative slots:
+
+- every iteration the active batch RE-FORMS: finished sequences retire
+  immediately (``gen_early_exits_total``), queued requests fold into free
+  slots mid-flight (``gen_fold_ins_total``), and past-deadline sequences
+  evict with PR 2's fast-504 contract (``gen_evictions_total`` +
+  ``deadline_exceeded_total``);
+- the per-model state block (KV caches, latent slabs, token buffers) is ONE
+  device-resident pytree with leading dim = slots, allocated at start and
+  threaded through the compiled step — steady-state serving allocates
+  nothing, and the host-side :class:`~tpuserve.genserve.arena.SlotArena`
+  ledger guarantees no slot is ever double-handed;
+- the three device programs (insert / step / extract) register in PR 6's
+  VariantKey registry via ``ModelRuntime.register_program``, so
+  ``runtime_compiles_total`` covers them and a delta of 0 across sustained
+  admit/retire/``:reload`` churn is the zero-recompile proof
+  (scripts/genserve_smoke.sh asserts it). Insert and extract take a TRACED
+  slot index — one compile serves every slot.
+
+The engine exposes the ModelBatcher surface (submit/start/stop/drain/
+revive_group_loops/pipeline_stats), so the existing front door — deadlines,
+breakers, result cache + coalescing, canaries, watchdog revival, graceful
+drain, the router tier — holds for multi-step requests unchanged. Blocking
+device work hops through the server's shared StageExecutors ("h2d" for
+inserts, "fetch" for step/extract readback, "postproc" for finalize), so
+generation shares the pipeline's stage-granularity scheduling and metrics.
+
+All engine state is event-loop-only (the step loop owns every mutation);
+there is deliberately no lock to witness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import numpy as np
+
+from tpuserve.batcher import DeadlineExceeded, QueueFull
+from tpuserve.config import GenserveConfig, PipelineConfig
+from tpuserve.genserve.arena import SlotArena, SlotInfo
+from tpuserve.genserve.model import GenerativeModel
+from tpuserve.hostpipe import StageExecutors
+from tpuserve.obs import Metrics
+
+log = logging.getLogger("tpuserve.genserve")
+
+
+@dataclass
+class _GenRequest:
+    item: Any
+    future: asyncio.Future = field(repr=False)
+    enqueued_at: float = 0.0
+    deadline_at: float | None = None
+
+
+class GenEngine:
+    """One iteration-level generation engine per served generative model."""
+
+    def __init__(self, model: GenerativeModel, runtime: Any,
+                 metrics: Metrics, gcfg: "GenserveConfig | None" = None,
+                 breaker: "Any | None" = None,
+                 injector: "Any | None" = None,
+                 stages: "StageExecutors | None" = None,
+                 pipeline_cfg: "PipelineConfig | None" = None) -> None:
+        self.model = model
+        self.runtime = runtime
+        self.metrics = metrics
+        self.cfg = model.cfg
+        self.gcfg = gcfg or GenserveConfig()
+        self.breaker = breaker
+        self.injector = injector
+        self.slots = self.gcfg.slots or max(self.cfg.batch_buckets)
+        self.arena = SlotArena(self.slots)
+        self._own_stages = stages is None
+        self.stages = stages if stages is not None \
+            else StageExecutors(pipeline_cfg or PipelineConfig(), metrics)
+        name = model.cfg.name
+        self.name = name
+        # Hot-path metric handles, prebound once (the batcher discipline).
+        self._c_iterations = metrics.counter(
+            f"gen_iterations_total{{model={name}}}")
+        self._c_admitted = metrics.counter(
+            f"gen_admitted_total{{model={name}}}")
+        self._c_fold_ins = metrics.counter(
+            f"gen_fold_ins_total{{model={name}}}")
+        self._c_early_exits = metrics.counter(
+            f"gen_early_exits_total{{model={name}}}")
+        self._c_evictions = metrics.counter(
+            f"gen_evictions_total{{model={name}}}")
+        self._c_deadline = metrics.counter(
+            f"deadline_exceeded_total{{model={name}}}")
+        self._c_items = metrics.counter(f"items_total{{model={name}}}")
+        self._c_units = metrics.counter(f"gen_units_total{{model={name}}}")
+        self._c_batch_errors = metrics.counter(
+            f"batch_errors_total{{model={name}}}")
+        self._c_shed = metrics.counter(f"shed_total{{model={name}}}")
+        self._g_queue_depth = metrics.gauge(f"queue_depth{{model={name}}}")
+        self._g_active = metrics.gauge(f"gen_active_slots{{model={name}}}")
+        self._h_step = metrics.histogram(f"gen_step_ms{{model={name}}}")
+        self._h_insert = metrics.histogram(f"gen_insert_ms{{model={name}}}")
+        self._h_extract = metrics.histogram(f"gen_extract_ms{{model={name}}}")
+        self._h_queue = metrics.histogram(
+            f"latency_ms{{model={name},phase=queue}}")
+        self._pending: collections.deque[_GenRequest] = collections.deque()
+        self._state: Any = None
+        self._state_struct: Any = None
+        self._loop_task: asyncio.Task | None = None
+        self._work_event: asyncio.Event | None = None
+        self._idle_event: asyncio.Event | None = None
+        self._running = False
+        # Serving-rate model for estimate_clear_s (429 Retry-After).
+        self._ewma_step_ms: float | None = None
+        self._ewma_iters: float | None = None
+        # Runaway guard: a slot that somehow never reports done is failed
+        # (and freed) past this bound instead of pinning its slot forever.
+        self._max_steps_guard = 2 * max(1, model.gen_max_steps())
+
+    # -- compilation ----------------------------------------------------------
+    def compile(self) -> None:
+        """Register the insert/step/extract programs in the runtime's
+        specialized-variant registry and execute each once (prewarm: PJRT
+        program load off the first request's latency). Blocking; call from
+        ServerState.build."""
+        model, rt = self.model, self.runtime
+        t0 = time.perf_counter()
+        self._state_struct = model.state_signature(self.slots)
+        if "step" in rt.gen_programs:
+            # Programs already registered on this runtime (a second engine
+            # over the same runtime — tests, restarts). Reuse requires the
+            # same slot width: the compiled state block is shape-frozen.
+            step_key = next(k for k in rt.variants
+                            if k.bucket and k.bucket[0] == "step")
+            if step_key.bucket[1] != self.slots:
+                raise ValueError(
+                    f"{self.name}: runtime programs were compiled for "
+                    f"{step_key.bucket[1]} slots, engine wants {self.slots}")
+            return
+        item_struct = model.gen_item_signature()
+        slot_struct = jax.ShapeDtypeStruct((), np.int32)
+
+        def insert_fn(params, state, slot, item):
+            fresh = model.init_state(params, item)
+            return jax.tree_util.tree_map(
+                lambda s, u: jax.lax.dynamic_update_index_in_dim(
+                    s, u.astype(s.dtype), slot, 0),
+                state, fresh)
+
+        rt.register_program("insert", insert_fn,
+                            (self._state_struct, slot_struct, item_struct),
+                            width=self.slots, donate_argnums=(0,))
+        rt.register_program("step", model.step, (self._state_struct,),
+                            width=self.slots, donate_argnums=(0,))
+        rt.register_program("extract", model.extract,
+                            (self._state_struct, slot_struct),
+                            width=self.slots)
+        # Prewarm: one insert + step + extract on a zero state block, with a
+        # dependent read per program (the only honest completion signal).
+        state = rt.run_program("insert", self._host_zeros(self._state_struct),
+                               np.int32(0), model.canary_item())
+        state, out = rt.run_program("step", state)
+        jax.tree_util.tree_map(np.asarray, out)
+        jax.tree_util.tree_map(
+            np.asarray, rt.run_program("extract", state, np.int32(0)))
+        log.info("%s: generation engine compiled+prewarmed %d slots in %.1fs",
+                 self.name, self.slots, time.perf_counter() - t0)
+
+    @staticmethod
+    def _host_zeros(struct: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda s: np.zeros(tuple(s.shape), s.dtype), struct)
+
+    # -- lifecycle ------------------------------------------------------------
+    async def start(self) -> None:
+        self._state = self._host_zeros(self._state_struct)
+        self._work_event = asyncio.Event()
+        self._idle_event = asyncio.Event()
+        self._idle_event.set()
+        self._running = True
+        self._loop_task = asyncio.get_running_loop().create_task(
+            self._step_loop())
+
+    async def stop(self) -> None:
+        """Cancel the step loop, fail queued and mid-flight requests."""
+        self._running = False
+        t = self._loop_task
+        if t is not None:
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+            except Exception:
+                log.exception("step loop for %s failed during stop", self.name)
+            self._loop_task = None
+        err = RuntimeError(f"server shutting down; {self.name} not served")
+        while self._pending:
+            req = self._pending.popleft()
+            if not req.future.done():
+                req.future.set_exception(err)
+        for info in self.arena.release_all():
+            if not info.future.done():
+                info.future.set_exception(err)
+        self._g_queue_depth.set(0)
+        self._g_active.set(0)
+        self._maybe_idle()
+        if self._own_stages:
+            self.stages.shutdown()
+
+    def revive_group_loops(self) -> int:
+        """Watchdog hook (same name as the batcher's so server registration
+        is uniform): restart the step loop if it died. Mid-flight slots are
+        still in the arena, so a revived loop resumes stepping them."""
+        if not self._running:
+            return 0
+        t = self._loop_task
+        if t is not None and not t.done():
+            return 0
+        if t is not None and not t.cancelled() and t.exception() is not None:
+            log.error("step loop for %s died: %r — restarting", self.name,
+                      t.exception())
+        self._loop_task = asyncio.get_running_loop().create_task(
+            self._step_loop())
+        return 1
+
+    async def drain(self, deadline: float) -> bool:
+        """Graceful drain: wait until every accepted request (queued or
+        mid-generation) resolved, bounded by ``deadline`` (event-loop
+        clock). Same idle-event discipline as the batcher."""
+        loop = asyncio.get_running_loop()
+        while self._pending or self.arena.n_active:
+            timeout = deadline - loop.time()
+            if timeout <= 0:
+                break
+            self._idle_event.clear()
+            if not self._pending and not self.arena.n_active:
+                break
+            try:
+                await asyncio.wait_for(self._idle_event.wait(), timeout)
+            except asyncio.TimeoutError:
+                break
+        self._maybe_idle()
+        return not self._pending and not self.arena.n_active
+
+    # -- submission (event loop) ----------------------------------------------
+    def submit(self, item: Any, group: Any = None,
+               deadline_at: float | None = None) -> asyncio.Future:
+        """Enqueue one decoded request; returns a Future of its result.
+        ``group`` is accepted for batcher-API parity and ignored — the
+        engine has one slot block, not per-group queues."""
+        if not self._running or self._work_event is None:
+            raise RuntimeError(f"engine for {self.name} not started")
+        if len(self._pending) >= self.cfg.max_queue:
+            self._c_shed.inc()
+            raise QueueFull(self.name)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append(_GenRequest(
+            item=item, future=fut, enqueued_at=time.perf_counter(),
+            deadline_at=deadline_at))
+        self._g_queue_depth.set(len(self._pending))
+        self._idle_event.clear()
+        self._work_event.set()
+        return fut
+
+    def _maybe_idle(self) -> None:
+        if self._idle_event is not None and not self._pending \
+                and not self.arena.n_active:
+            self._idle_event.set()
+
+    # -- step loop (event loop) -----------------------------------------------
+    async def _step_loop(self) -> None:
+        name = self.name
+        while True:
+            if self.injector is not None:
+                # Chaos: an escaped exception kills this task — exactly the
+                # failure revive_group_loops exists to repair.
+                self.injector.check("kill_group_loop", name)
+            self._expire_pending()
+            self._evict_expired()
+            if not self.arena.n_active and not self._pending:
+                self._maybe_idle()
+                self._work_event.clear()
+                if not self._pending and not self.arena.n_active:
+                    await self._work_event.wait()
+                continue
+            await self._admit()
+            if not self.arena.n_active:
+                continue
+            try:
+                if self.injector is not None:
+                    delay = self.injector.delay_s("slow_dispatch", name)
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                    self.injector.check("batch_error", name)
+                t0 = time.perf_counter()
+                out = await self.stages.run(name, "fetch", self._step_sync)
+                step_ms = (time.perf_counter() - t0) * 1e3
+                self._h_step.observe(step_ms)
+                self._observe_step(step_ms)
+                self._c_iterations.inc()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — contained per batch
+                await self._fail_active(e)
+                continue
+            await self._retire(out)
+
+    def _step_sync(self) -> dict:
+        """One compiled iteration over the slot block + the small host
+        fetch of the out pytree. Runs on the fetch stage executor."""
+        self._state, out = self.runtime.run_program("step", self._state)
+        return jax.tree_util.tree_map(np.asarray, out)
+
+    def _insert_sync(self, slot: int, item: Any) -> None:
+        self._state = self.runtime.run_program(
+            "insert", self._state, np.int32(slot), item)
+
+    def _extract_sync(self, slot: int) -> Any:
+        return jax.tree_util.tree_map(
+            np.asarray,
+            self.runtime.run_program("extract", self._state, np.int32(slot)))
+
+    # -- scheduling passes ----------------------------------------------------
+    def _expire_pending(self) -> None:
+        """Fail queued requests whose deadline passed and drop cancelled
+        ones — rejected in microseconds, never admitted (fast-504)."""
+        if not self._pending:
+            return
+        now = time.perf_counter()
+        live: collections.deque[_GenRequest] = collections.deque()
+        n_expired = 0
+        for req in self._pending:
+            if req.future.done():
+                continue
+            if req.deadline_at is not None and now >= req.deadline_at:
+                req.future.set_exception(DeadlineExceeded(
+                    "deadline expired after "
+                    f"{(now - req.enqueued_at) * 1e3:.0f} ms in queue"))
+                n_expired += 1
+                continue
+            live.append(req)
+        if n_expired:
+            self._c_deadline.inc(n_expired)
+        if len(live) != len(self._pending):
+            self._pending = live
+            self._g_queue_depth.set(len(live))
+
+    def _evict_expired(self) -> None:
+        """Mid-generation deadline eviction: a slot whose request deadline
+        passed (or whose client went away) frees NOW — its remaining
+        iterations are never computed for nobody (Clockwork P3). The
+        freed slot's device lanes hold stale state until the next insert
+        overwrites them; their own done-flag freezes them within the
+        model's step bound, so the garbage compute is bounded and the
+        ledger stays exact."""
+        now = time.perf_counter()
+        for slot in self.arena.active_slots():
+            info = self.arena.peek(slot)
+            if info.future.done():  # client disconnected mid-generation
+                self.arena.release(slot)
+                continue
+            if info.deadline_at is not None and now >= info.deadline_at:
+                info.future.set_exception(DeadlineExceeded(
+                    f"deadline expired after {info.iterations} iteration(s) "
+                    f"({(now - info.enqueued_at) * 1e3:.0f} ms total)"))
+                self._c_deadline.inc()
+                self._c_evictions.inc()
+                self.arena.release(slot)
+        self._g_active.set(self.arena.n_active)
+
+    async def _admit(self) -> None:
+        """Fold queued requests into free slots — mid-flight when the block
+        is already generating (the continuous-batching property)."""
+        cap = self.gcfg.admit_per_step or self.slots
+        admitted = 0
+        while self.arena.n_free and self._pending and admitted < cap:
+            req = self._pending.popleft()
+            self._g_queue_depth.set(len(self._pending))
+            if req.future.done():
+                continue
+            now = time.perf_counter()
+            if req.deadline_at is not None and now >= req.deadline_at:
+                req.future.set_exception(DeadlineExceeded(
+                    "deadline expired after "
+                    f"{(now - req.enqueued_at) * 1e3:.0f} ms in queue"))
+                self._c_deadline.inc()
+                continue
+            fold = any(self.arena.peek(s).iterations > 0
+                       for s in self.arena.active_slots())
+            info = SlotInfo(item=req.item, future=req.future,
+                            deadline_at=req.deadline_at,
+                            enqueued_at=req.enqueued_at, admitted_at=now)
+            slot = self.arena.acquire(info)
+            self._h_queue.observe((now - req.enqueued_at) * 1e3)
+            t0 = time.perf_counter()
+            try:
+                await self.stages.run(self.name, "h2d", self._insert_sync,
+                                      slot, req.item)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                # The state block may be half-written (and donated buffers
+                # consumed on TPU): hard-reset like a step failure. The
+                # admitting request fails with the cause too.
+                self.arena.release(slot)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                await self._fail_active(e)
+                return
+            self._h_insert.observe((time.perf_counter() - t0) * 1e3)
+            self._c_admitted.inc()
+            admitted += 1
+            if fold:
+                self._c_fold_ins.inc()
+        self._g_active.set(self.arena.n_active)
+
+    async def _retire(self, out: dict) -> None:
+        """Account the iteration and retire every finished slot
+        immediately — a short sequence exits the instant its own work is
+        done, regardless of what the rest of the block still owes."""
+        for slot in self.arena.active_slots():
+            self.arena.peek(slot).iterations += 1
+        for slot in self.arena.active_slots():
+            info = self.arena.peek(slot)
+            if info.future.done():
+                self.arena.release(slot)
+                continue
+            if info.iterations > self._max_steps_guard:
+                info.future.set_exception(RuntimeError(
+                    f"{self.name}: slot {slot} exceeded the "
+                    f"{self._max_steps_guard}-iteration guard without "
+                    "reporting done"))
+                self._c_batch_errors.inc()
+                self.arena.release(slot)
+                continue
+            if not self.model.is_finished(out, slot):
+                continue
+            early = self.arena.n_active > 1 or bool(self._pending)
+            t0 = time.perf_counter()
+            try:
+                extracted = await self.stages.run(
+                    self.name, "fetch", self._extract_sync, slot)
+                self._h_extract.observe((time.perf_counter() - t0) * 1e3)
+                result = await self.stages.run(
+                    self.name, "postproc", self.model.finalize, extracted,
+                    info.item)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — contained to this slot
+                log.exception("retire failed for %s slot %d", self.name, slot)
+                self._c_batch_errors.inc()
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                if not info.future.done():
+                    info.future.set_exception(e)
+            else:
+                if not info.future.done():
+                    info.future.set_result(result)
+                self._c_items.inc()
+                self._c_units.inc(self.model.result_units(result))
+                self._observe_retire(info.iterations)
+                if early:
+                    self._c_early_exits.inc()
+                if self.breaker is not None:
+                    self.breaker.record_success()
+                wall1 = time.time()
+                self.metrics.tracer.add(
+                    f"gen[{info.iterations}it]",
+                    wall1 - (time.perf_counter() - info.enqueued_at), wall1,
+                    tid=self.name, iterations=info.iterations)
+            self.arena.release(slot)
+        self._g_active.set(self.arena.n_active)
+        self._maybe_idle()
+
+    async def _fail_active(self, e: Exception) -> None:
+        """A step/insert failure poisons the whole state block: fail every
+        mid-flight request with the cause, free all slots, and reinitialize
+        the block to zeros. The step loop and queued requests survive —
+        failure is contained to the in-flight generation set."""
+        log.exception("generation step failed for %s", self.name)
+        self._c_batch_errors.inc()
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        for info in self.arena.release_all():
+            if not info.future.done():
+                info.future.set_exception(e)
+        self._state = self._host_zeros(self._state_struct)
+        self._g_active.set(0)
+        self._maybe_idle()
+
+    # -- staged canary (lifecycle hook; runs in an executor thread) -----------
+    def staged_canary_sync(self, staged: list[Any]) -> None:
+        """Run a SHORT generation end-to-end against a staged candidate
+        tree (params_override) through the real compiled programs, on a
+        scratch state block — the live block and the serving loop are
+        untouched. Any non-finite output, empty result, or failure to
+        finish within the model's step bound rejects the candidate
+        (tpuserve.lifecycle wires this in place of the one-shot
+        staged-canary path for engine-served models)."""
+        model, rt = self.model, self.runtime
+        item = model.canary_item()
+        state = rt.run_program(
+            "insert", self._host_zeros(self._state_struct), np.int32(0),
+            item, params_override=staged)
+        for _ in range(self._max_steps_guard):
+            state, out = rt.run_program("step", state, params_override=staged)
+            if bool(np.asarray(out["done"])[0]):
+                break
+        else:
+            raise ValueError(
+                f"staged canary did not finish a generation within "
+                f"{self._max_steps_guard} iterations")
+        extracted = jax.tree_util.tree_map(
+            np.asarray,
+            rt.run_program("extract", state, np.int32(0),
+                           params_override=staged))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(extracted)[0]:
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                raise ValueError(
+                    "staged canary produced non-finite outputs in "
+                    f"{jax.tree_util.keystr(path)}")
+        if model.finalize(extracted, item) is None:
+            raise ValueError("staged canary produced no result")
+
+    # -- introspection --------------------------------------------------------
+    def _observe_step(self, ms: float) -> None:
+        prev = self._ewma_step_ms
+        self._ewma_step_ms = ms if prev is None else prev + 0.2 * (ms - prev)
+
+    def _observe_retire(self, iters: int) -> None:
+        prev = self._ewma_iters
+        self._ewma_iters = (float(iters) if prev is None
+                            else prev + 0.2 * (iters - prev))
+
+    def estimate_clear_s(self) -> float | None:
+        """Queue-clear estimate for 429 Retry-After hints: pending requests
+        times the observed iterations-per-request, priced at the step EWMA,
+        amortized over the slot width. None before any retirement."""
+        if not self._pending:
+            return None
+        if not self._ewma_step_ms or not self._ewma_iters:
+            return None
+        per_req_s = self._ewma_iters * self._ewma_step_ms / 1e3
+        return len(self._pending) * per_req_s / max(1, self.slots)
+
+    def pipeline_stats(self) -> dict:
+        """The /stats "pipeline" block entry for this model (the engine's
+        counterpart of the batcher's; mode "genserve" tells them apart)."""
+        per_slot = [
+            {"slot": s, "iterations": self.arena.peek(s).iterations}
+            for s in self.arena.active_slots()]
+        return {
+            "mode": "genserve",
+            "slots": self.slots,
+            "active": self.arena.n_active,
+            "free": self.arena.n_free,
+            "pending": len(self._pending),
+            "admitted_total": self.arena.acquires_total,
+            "iterations_total": self._c_iterations.value,
+            "fold_ins_total": self._c_fold_ins.value,
+            "early_exits_total": self._c_early_exits.value,
+            "evictions_total": self._c_evictions.value,
+            "step_ewma_ms": round(self._ewma_step_ms, 3)
+            if self._ewma_step_ms else None,
+            "iters_per_request_ewma": round(self._ewma_iters, 2)
+            if self._ewma_iters else None,
+            "per_slot": per_slot,
+        }

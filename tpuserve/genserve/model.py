@@ -1,0 +1,109 @@
+"""GenerativeModel: the contract between generative families and the
+iteration-level engine (ISSUE 9; Orca, PAPERS.md P4).
+
+The one-shot ``ServingModel`` contract compiles ``forward`` per batch bucket
+and runs each batch to completion — a locked batch. Multi-step generative
+work (autoregressive text, diffusion denoising) breaks that shape: requests
+need different iteration counts, so a locked batch runs every lane for the
+LONGEST member. This contract decomposes generation into the three device
+programs the engine (tpuserve.genserve.engine) schedules at iteration
+granularity, all compiled ONCE over a fixed slot-capacity state block so
+slot churn never recompiles:
+
+- ``init_state(params, item)``  — one request's initial per-slot state
+  (prompt prefill / text encode + latent init). The engine composes it with
+  a traced dynamic-update into the slot dim, so one compiled "insert"
+  program serves every slot index.
+- ``step(params, state)``       — ONE model iteration over the whole slot
+  block, returning the new state plus a small host-fetchable out pytree
+  that must carry ``"done"`` per slot. Inactive/free slots hold benign
+  zeros and are stepped along harmlessly (their lanes are ignored).
+- ``extract(params, state, slot)`` — the finished slot's device outputs
+  (token buffer, VAE-decoded image), fetched ONLY when that slot retires,
+  so per-step readback stays small even when results are megabytes.
+
+Host-side, ``is_finished`` reads the step out-block and ``finalize`` turns
+one extracted result into the JSON-able / bytes response. Decoded request
+items must be pytrees of fixed-shape np arrays carrying EVERY sampling
+parameter (seed, temperature, max_new_tokens) — that is what makes
+generative results content-addressable: the result cache digests the item,
+so two prompts differing only in seed can never alias
+(tests/test_genserve.py; ``ModelConfig.cacheable`` opts a family out).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any
+
+from tpuserve.models.base import ServingModel
+
+
+class GenerativeModel(ServingModel):
+    """A ServingModel that additionally serves through the iteration-level
+    engine. Families keep their one-shot ``forward`` (the locked-batch
+    path: still used by the static batcher when [genserve] is off, and as
+    the bench's baseline), and add the decomposed programs below."""
+
+    # Marker the server keys engine selection on (isinstance would also
+    # work; the attribute makes duck-typed test doubles cheap).
+    generative = True
+
+    # -- device contract (jittable; compiled once via runtime.register_program)
+    @abc.abstractmethod
+    def state_signature(self, slots: int) -> Any:
+        """Pytree of jax.ShapeDtypeStruct for the whole generative state
+        block: every leaf has leading dim ``slots``. Allocated once at
+        engine start (zeros) and threaded through step — KV caches, latent
+        slabs, token buffers, per-slot counters and done flags all live
+        here, so steady-state serving allocates nothing."""
+
+    @abc.abstractmethod
+    def gen_item_signature(self) -> Any:
+        """Pytree of jax.ShapeDtypeStruct for ONE decoded request item as it
+        crosses to the device (no slot dim). Fixed shapes are the contract:
+        prompts pad to the prompt bucket, and every sampling parameter rides
+        along as a scalar array."""
+
+    @abc.abstractmethod
+    def init_state(self, params: Any, item: Any) -> Any:
+        """Jittable: one request's initial per-slot state — each leaf shaped
+        like the state_signature leaf WITHOUT the slot dim. This is the
+        expensive once-per-request work (prompt prefill through the stack,
+        text encode, latent init from the seed)."""
+
+    @abc.abstractmethod
+    def step(self, params: Any, state: Any) -> tuple[Any, dict]:
+        """Jittable: one iteration over all slots -> (new_state, out).
+        ``out`` is the small per-step host fetch and must contain
+        ``"done"``: (slots,) bool — True once a slot's sequence finished.
+        Free slots hold zeros; the step must be NaN-safe on them."""
+
+    @abc.abstractmethod
+    def extract(self, params: Any, state: Any, slot: Any) -> Any:
+        """Jittable with a TRACED slot index: the finished slot's final
+        device outputs (one compile covers every slot). Runs once per
+        retirement — put the heavy tail work here (e.g. the VAE decode)."""
+
+    # -- host contract --------------------------------------------------------
+    def gen_max_steps(self) -> int:
+        """Upper bound on iterations any single request can take (the
+        engine's runaway guard and the staged canary's loop bound)."""
+        raise NotImplementedError
+
+    def is_finished(self, step_out: dict, slot: int) -> bool:
+        """Read one slot's finished flag from the fetched step out-block."""
+        return bool(step_out["done"][slot])
+
+    @abc.abstractmethod
+    def finalize(self, extracted: Any, item: Any) -> Any:
+        """Fetched extract() outputs (+ the original decoded item) -> the
+        JSON-able / bytes response. Host-side, runs on the postproc stage."""
+
+    def result_units(self, result: Any) -> float:
+        """Headline output units one finished result carries — tokens for
+        text, images for diffusion (default 1). Feeds the engine's
+        ``gen_units_total`` counter, which is what bench.py's generative
+        mode divides by wall time for its tokens/s / images-per-minute
+        headline (counting requests would hide mixed output lengths)."""
+        return 1.0

@@ -86,7 +86,8 @@ class ModelLifecycle:
                  breaker: Any | None = None,
                  canary: Callable[[], Awaitable[bool]] | None = None,
                  canary_status: Callable[[], bool | None] | None = None,
-                 injector: Any | None = None) -> None:
+                 injector: Any | None = None,
+                 staged_canary_fn: Callable[[list], None] | None = None) -> None:
         self.name = name
         self.runtime = runtime
         self.model = model
@@ -100,6 +101,12 @@ class ModelLifecycle:
         # Cheap read of the latest periodic-canary verdict (state.canary_ok);
         # the soak monitor watches it without submitting extra probes.
         self._canary_status = canary_status
+        # Replacement staged-canary body (blocking; runs in the executor):
+        # engine-served generative models pass GenEngine.staged_canary_sync
+        # so the candidate proves itself on a SHORT end-to-end generation
+        # through the real compiled insert/step/extract programs, instead
+        # of the one-shot forward path they no longer compile.
+        self._staged_canary_fn = staged_canary_fn
         self.injector = injector
         self._lock = new_async_lock("lifecycle.ModelLifecycle")
         self._soak_task: asyncio.Task | None = None
@@ -239,6 +246,9 @@ class ModelLifecycle:
         traffic. Dispatches go out async first so the replica loads
         overlap; one fetch per replica then proves each. Sharded mode has
         one mesh, so this degenerates to the single canary it always was."""
+        if self._staged_canary_fn is not None:
+            self._staged_canary_fn(staged)
+            return
         item = self.model.canary_item()
         bucket = self.model.bucket_for(1, group=self.model.group_key(item))
         host_batch = self.model.assemble([item], bucket)

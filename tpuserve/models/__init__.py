@@ -11,6 +11,8 @@ Families (BASELINE.json ``configs``):
 - bert           — BERT-base text classification, bucketed seq lens
 - efficientdet   — EfficientDet-D0 detection with fixed-shape NMS
 - sd15           — Stable Diffusion 1.5 txt2img, fori_loop denoise
+- textgen        — autoregressive prefix-LM text generation (KV-cache
+                   decode via the iteration-level engine, ISSUE 9)
 """
 
 from __future__ import annotations
@@ -27,6 +29,7 @@ _REGISTRY: dict[str, str] = {
     "bert": "tpuserve.models.bert",
     "efficientdet": "tpuserve.models.efficientdet",
     "sd15": "tpuserve.models.sd15",
+    "textgen": "tpuserve.models.textgen",
     "toy": "tpuserve.models.toy",
 }
 

@@ -45,6 +45,11 @@ class ServingModel(abc.ABC):
     def __init__(self, cfg: ModelConfig) -> None:
         self.cfg = cfg
         self.name = cfg.name
+        # Result-cache eligibility (server ModelCache + router wire cache).
+        # Config-driven so operators can opt a nondeterministic deployment
+        # out; families whose sampling params all ride inside the decoded
+        # item (textgen, sd15) are safely cacheable by construction.
+        self.cacheable = bool(getattr(cfg, "cacheable", True))
         self.class_labels: list[str] | None = None
         if cfg.labels:
             with open(cfg.labels, encoding="utf-8") as f:

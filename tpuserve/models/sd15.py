@@ -47,7 +47,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from tpuserve.config import ModelConfig
-from tpuserve.models.base import ServingModel
+from tpuserve.genserve.model import GenerativeModel
 from tpuserve.text import CLIPBPETokenizer, WordPieceTokenizer, synthetic_vocab
 
 MAX_TOKENS = 77  # CLIP text context length; SD conditions on all 77 states.
@@ -388,10 +388,20 @@ def ddim_schedule(steps: int, train_steps: int = 1000,
 
 # -- serving -------------------------------------------------------------------
 
-class SD15Serving(ServingModel):
+class SD15Serving(GenerativeModel):
     """txt2img over HTTP: JSON {"prompt", "negative_prompt"?, "seed"?} in,
     PNG bytes out. The negative prompt rides the classifier-free-guidance
-    uncond lane (empty prompt when unset), steering generation away from it."""
+    uncond lane (empty prompt when unset), steering generation away from it.
+
+    Two serving shapes (both deterministic in (prompt, negative, seed)):
+    the one-shot ``forward`` bakes the whole N-step denoise loop into one
+    executable (the static batcher's locked-batch path), and the
+    GenerativeModel decomposition serves the SAME math through the
+    iteration-level engine — ``init_state`` text-encodes + seeds latents,
+    each ``step`` is one DDIM iteration over the slot block (per-slot step
+    counters, so freshly folded-in requests denoise beside half-finished
+    ones), and ``extract`` runs the VAE decode only when a slot finishes.
+    Fixed ``steps`` per request keeps the large-activation path static."""
 
     def __init__(self, cfg: ModelConfig) -> None:
         super().__init__(cfg)
@@ -504,6 +514,77 @@ class SD15Serving(ServingModel):
         img = jnp.clip((img + 1.0) * 127.5, 0.0, 255.0).astype(jnp.uint8)
         return {"image": img}
 
+    # -- engine decomposition (tpuserve.genserve) -------------------------------
+    def state_signature(self, slots: int) -> Any:
+        return {
+            "lat": jax.ShapeDtypeStruct(
+                (slots, self.latent, self.latent, 4), jnp.float32),
+            "ctx": jax.ShapeDtypeStruct(
+                (slots, 2, MAX_TOKENS, self.text_encoder.d_model), self.dtype),
+            "step_i": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "done": jax.ShapeDtypeStruct((slots,), jnp.bool_),
+        }
+
+    def gen_item_signature(self) -> Any:
+        return (
+            jax.ShapeDtypeStruct((MAX_TOKENS,), jnp.int32),
+            jax.ShapeDtypeStruct((MAX_TOKENS,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+
+    def init_state(self, params: Any, item: Any) -> Any:
+        """Once-per-request work: text-encode cond + uncond, seed the
+        latent. Same math as forward's prologue, per slot."""
+        ids, neg_ids, seed = item
+        ctx2 = self.text_encoder.apply(
+            params["text"], jnp.stack([neg_ids, ids]))  # (2, 77, D)
+        key = jax.random.fold_in(jax.random.key(0), seed)
+        lat = jax.random.normal(
+            key, (self.latent, self.latent, 4), jnp.float32)
+        return {"lat": lat, "ctx": ctx2.astype(self.dtype),
+                "step_i": jnp.int32(0), "done": jnp.bool_(False)}
+
+    def step(self, params: Any, state: Any) -> tuple[Any, dict]:
+        """One DDIM iteration over the whole slot block, each slot at its
+        OWN schedule index — a request folded in at iteration 400 of the
+        block's life denoises from its own t=high-noise next to slots
+        about to finish. Finished/free slots freeze via ``done``."""
+        lat, ctx, step_i, done = (state["lat"], state["ctx"],
+                                  state["step_i"], state["done"])
+        b = lat.shape[0]
+        ts, a_t, a_prev = (jnp.asarray(x) for x in self.schedule)
+        g = jnp.float32(self.guidance)
+        idx = jnp.clip(step_i, 0, self.steps - 1)
+        t2 = jnp.concatenate([ts[idx], ts[idx]], axis=0)  # (2B,)
+        x2 = jnp.concatenate([lat, lat], axis=0)
+        ctx2 = jnp.concatenate([ctx[:, 0], ctx[:, 1]], axis=0)  # (2B, 77, D)
+        eps2 = self.unet.apply(params["unet"], x2, t2, ctx2)
+        eps_u, eps_c = jnp.split(eps2, 2, axis=0)
+        eps = eps_u + g * (eps_c - eps_u)
+        at = a_t[idx][:, None, None, None]
+        ap = a_prev[idx][:, None, None, None]
+        x0 = (lat - jnp.sqrt(1.0 - at) * eps) / jnp.sqrt(at)
+        new_lat = jnp.sqrt(ap) * x0 + jnp.sqrt(1.0 - ap) * eps
+        lat2 = jnp.where(done[:, None, None, None], lat, new_lat)
+        step2 = jnp.where(done, step_i, step_i + 1)
+        done2 = step2 >= self.steps
+        return ({"lat": lat2, "ctx": ctx, "step_i": step2, "done": done2},
+                {"done": done2, "step_i": step2})
+
+    def extract(self, params: Any, state: Any, slot: Any) -> Any:
+        """The tail work runs ONCE per finished slot: VAE decode + uint8
+        quantization of that slot's latent only."""
+        lat = jax.lax.dynamic_index_in_dim(state["lat"], slot, 0)  # (1,h,w,4)
+        img = self.vae.apply(params["vae"], lat / 0.18215)
+        img = jnp.clip((img + 1.0) * 127.5, 0.0, 255.0).astype(jnp.uint8)
+        return {"image": img[0]}
+
+    def gen_max_steps(self) -> int:
+        return self.steps
+
+    def finalize(self, extracted: Any, item: Any) -> bytes:
+        return self._png(np.asarray(extracted["image"]))
+
     # -- host side --------------------------------------------------------------
     def _tokenize(self, prompt: str) -> np.ndarray:
         """Prompt -> fixed (77,) int32: BOS + pieces + EOS, pad-id padded."""
@@ -530,15 +611,17 @@ class SD15Serving(ServingModel):
         return self.host_decode(b'{"prompt": "canary", "seed": 1}',
                                 "application/json")
 
-    def host_postprocess(self, outputs: dict, n_valid: int) -> list[bytes]:
+    @staticmethod
+    def _png(arr: np.ndarray) -> bytes:
         from PIL import Image
 
-        res = []
-        for r in range(n_valid):
-            buf = io.BytesIO()
-            Image.fromarray(np.asarray(outputs["image"][r])).save(buf, "PNG")
-            res.append(buf.getvalue())
-        return res
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, "PNG")
+        return buf.getvalue()
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[bytes]:
+        return [self._png(np.asarray(outputs["image"][r]))
+                for r in range(n_valid)]
 
     # -- parallelism ------------------------------------------------------------
     def partition_rules(self) -> list[tuple[str, P]]:

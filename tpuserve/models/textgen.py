@@ -1,0 +1,396 @@
+"""Autoregressive text generation (ISSUE 9) — the token-by-token family the
+iteration-level engine exists for.
+
+A prefix-LM decoder: the prompt is encoded **bidirectionally** in one
+prefill pass (which is exactly the per-key-bias shape the seeded Pallas
+flash-attention kernel supports — ``options.attention = "flash"`` routes
+prefill through ``tpuserve.ops.flash_attention``; generated tokens then
+decode strictly left-to-right against the KV cache). Sampling is seeded and
+positional (``fold_in(fold_in(key(0), seed), position)``), so identical
+(prompt, seed, temperature, max_new_tokens) requests produce identical
+token streams across processes, batch compositions, and — the property
+tests/test_genserve.py leans on — across the TWO serving paths:
+
+- ``forward`` — the locked-batch twin: prefill + a ``lax.fori_loop`` over
+  the FULL ``max_new_tokens`` cap for every lane. This is what the static
+  batcher serves ([genserve] off) and what the bench's locked-batch
+  baseline measures: a 2-token completion pays the full loop.
+- ``init_state`` / ``step`` / ``extract`` — the engine decomposition:
+  prefill is the once-per-request insert, each step decodes ONE token for
+  every active slot against the per-slot KV cache
+  (slots, layers, ctx, heads, head_dim), and a finished slot's token
+  buffer is extracted the moment its own ``done`` flag flips.
+
+Both paths share ``_prefill`` and ``_decode_step`` verbatim, so engine ==
+locked-batch token parity holds by construction. Tokenization reuses
+``tpuserve.text`` WordPiece over the deterministic synthetic vocab (no
+artifacts, SURVEY.md §7 hard part 8); [SEP] doubles as EOS.
+
+Sizes come from ``cfg.options`` (layers/d_model/heads/d_ff/vocab_size/
+prompt_len/max_new_tokens) with small dev defaults; tests use tiny sizes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve.config import ModelConfig
+from tpuserve.genserve.model import GenerativeModel
+from tpuserve.text import WordPieceTokenizer, synthetic_vocab
+
+
+def _norm(x, scale, bias, eps=1e-5):
+    """LayerNorm in f32, cast back to the compute dtype."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(x.dtype)
+
+
+class TextGenServing(GenerativeModel):
+    """Decoder-only generation over HTTP: JSON {"prompt", "seed"?,
+    "max_new_tokens"?, "temperature"?} in, {"text", "tokens", "n_tokens"}
+    out. Every sampling parameter rides inside the decoded item, so the
+    result cache can never alias two requests differing only in seed."""
+
+    def __init__(self, cfg: ModelConfig) -> None:
+        super().__init__(cfg)
+        o = cfg.options
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.layers = int(o.get("layers", 4))
+        self.d_model = int(o.get("d_model", 256))
+        self.heads = int(o.get("heads", 4))
+        self.d_ff = int(o.get("d_ff", 4 * self.d_model))
+        # Prompt bucket (host pads every prompt to this) and the generation
+        # cap; the KV cache spans their sum.
+        self.max_prompt = int(o.get("prompt_len", 32))
+        self.max_new = int(o.get("max_new_tokens", 64))
+        self.max_ctx = self.max_prompt + self.max_new
+        if self.d_model % self.heads:
+            raise ValueError(
+                f"options.d_model={self.d_model} must divide by "
+                f"heads={self.heads}")
+        self.head_dim = self.d_model // self.heads
+        self.attention = str(o.get("attention", "dense"))
+        if self.attention not in ("dense", "flash"):
+            raise ValueError("options.attention must be 'dense' or 'flash', "
+                             f"got {self.attention!r}")
+        if self.attention == "flash" and self.max_prompt % 8:
+            raise ValueError(
+                f"options.attention='flash' needs prompt_len "
+                f"({self.max_prompt}) divisible by 8 (TPU tile rows)")
+        vocab_file = o.get("vocab_file")
+        if vocab_file:
+            self.tokenizer = WordPieceTokenizer.from_vocab_file(vocab_file)
+        else:
+            self.tokenizer = WordPieceTokenizer(
+                synthetic_vocab(int(o.get("vocab_size", 8192))))
+        self.vocab_size = max(self.tokenizer.vocab.values()) + 1
+        self.eos_id = self.tokenizer.sep_id
+
+    # -- params ---------------------------------------------------------------
+    def init_params(self, rng: jax.Array) -> Any:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        hd, h = self.head_dim, self.heads
+
+        def dense(key, shape):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (1.0 / math.sqrt(shape[0]))).astype(jnp.float32)
+
+        keys = iter(jax.random.split(rng, 6 * self.layers + 4))
+        params: dict = {
+            "embed": jax.random.normal(next(keys), (v, d), jnp.float32) * 0.02,
+            "pos": jax.random.normal(next(keys), (self.max_ctx, d),
+                                     jnp.float32) * 0.01,
+            "ln_f": {"scale": jnp.ones((d,), jnp.float32),
+                     "bias": jnp.zeros((d,), jnp.float32)},
+            "head": dense(next(keys), (d, v)),
+        }
+        for i in range(self.layers):
+            params[f"layer{i}"] = {
+                "ln1": {"scale": jnp.ones((d,), jnp.float32),
+                        "bias": jnp.zeros((d,), jnp.float32)},
+                "wq": dense(next(keys), (d, h * hd)),
+                "wk": dense(next(keys), (d, h * hd)),
+                "wv": dense(next(keys), (d, h * hd)),
+                "wo": dense(next(keys), (h * hd, d)),
+                "ln2": {"scale": jnp.ones((d,), jnp.float32),
+                        "bias": jnp.zeros((d,), jnp.float32)},
+                "w_up": dense(next(keys), (d, f)),
+                "w_down": dense(next(keys), (f, d)),
+            }
+        return params
+
+    # -- shapes ---------------------------------------------------------------
+    def input_signature(self, bucket: tuple) -> Any:
+        (b,) = bucket
+        p = self.max_prompt
+        return (
+            jax.ShapeDtypeStruct((b, p), jnp.int32),   # padded prompt ids
+            jax.ShapeDtypeStruct((b,), jnp.int32),     # prompt length
+            jax.ShapeDtypeStruct((b,), jnp.int32),     # seed
+            jax.ShapeDtypeStruct((b,), jnp.int32),     # max_new_tokens
+            jax.ShapeDtypeStruct((b,), jnp.float32),   # temperature
+        )
+
+    def gen_item_signature(self) -> Any:
+        p = self.max_prompt
+        return (
+            jax.ShapeDtypeStruct((p,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+
+    def state_signature(self, slots: int) -> Any:
+        ln, c, h, hd = self.layers, self.max_ctx, self.heads, self.head_dim
+        n = self.max_new
+        return {
+            "k": jax.ShapeDtypeStruct((slots, ln, c, h, hd), self.dtype),
+            "v": jax.ShapeDtypeStruct((slots, ln, c, h, hd), self.dtype),
+            "pos": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "tokens": jax.ShapeDtypeStruct((slots, n), jnp.int32),
+            "n_new": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "last": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "done": jax.ShapeDtypeStruct((slots,), jnp.bool_),
+            "seed": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "max_new": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "temp": jax.ShapeDtypeStruct((slots,), jnp.float32),
+        }
+
+    # -- shared device math ---------------------------------------------------
+    def _attend_prefill(self, q, k, v, key_bias):
+        """(B, P, H, hd) bidirectional attention with an additive per-key
+        padding bias (B, P) — flash kernel or the dense twin."""
+        if self.attention == "flash":
+            from tpuserve.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, key_bias)
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        s = s + key_bias[:, None, None, :]
+        p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def _sample(self, logits, seed, position, temp):
+        """Per-lane seeded sampling at a cache ``position``: greedy when
+        temp == 0, Gumbel-max otherwise — deterministic either way, and
+        identical between the locked-batch loop and the engine because the
+        fold key is (seed, target cache position)."""
+        def one(lg, sd, pos, t):
+            key = jax.random.fold_in(jax.random.fold_in(
+                jax.random.key(0), sd), pos)
+            g = jax.random.gumbel(key, lg.shape, jnp.float32)
+            safe_t = jnp.where(t > 0, t, 1.0)
+            sampled = jnp.argmax(lg / safe_t + g)
+            return jnp.where(t > 0, sampled, jnp.argmax(lg)).astype(jnp.int32)
+
+        return jax.vmap(one)(logits.astype(jnp.float32), seed, position, temp)
+
+    def _logits(self, params, x):
+        return (_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+                .astype(jnp.float32) @ params["head"].astype(jnp.float32))
+
+    def _prefill(self, params, ids, n, seed, max_new, temp):
+        """Batched prompt prefill -> the full decode state pytree (leading
+        dim B): per-layer KV for the prompt, plus the FIRST sampled token.
+        Shared verbatim by forward (locked batch) and init_state (engine)."""
+        b, p = ids.shape
+        ln, c, h, hd = self.layers, self.max_ctx, self.heads, self.head_dim
+        dt = self.dtype
+        x = (jnp.take(params["embed"], ids, axis=0)
+             + params["pos"][None, :p, :]).astype(dt)
+        key_bias = (jnp.arange(p)[None, :] >= n[:, None]) * jnp.float32(-1e9)
+        kc = jnp.zeros((b, ln, c, h, hd), dt)
+        vc = jnp.zeros((b, ln, c, h, hd), dt)
+        for i in range(ln):
+            lp = params[f"layer{i}"]
+            hx = _norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+            q = (hx @ lp["wq"].astype(dt)).reshape(b, p, h, hd)
+            k = (hx @ lp["wk"].astype(dt)).reshape(b, p, h, hd)
+            v = (hx @ lp["wv"].astype(dt)).reshape(b, p, h, hd)
+            kc = kc.at[:, i, :p].set(k)
+            vc = vc.at[:, i, :p].set(v)
+            a = self._attend_prefill(q, k, v, key_bias).reshape(b, p, h * hd)
+            x = x + a.astype(dt) @ lp["wo"].astype(dt)
+            hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
+            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
+                     @ lp["w_down"].astype(dt))
+        h_last = jnp.take_along_axis(
+            x, jnp.maximum(n - 1, 0)[:, None, None], axis=1)[:, 0, :]
+        first = self._sample(self._logits(params, h_last[:, None, :])[:, 0, :],
+                             seed, n, temp)
+        tokens = jnp.zeros((b, self.max_new), jnp.int32)
+        tokens = tokens.at[:, 0].set(first)
+        done = (first == self.eos_id) | (max_new <= 1)
+        return {
+            "k": kc, "v": vc, "pos": n, "tokens": tokens,
+            "n_new": jnp.ones((b,), jnp.int32), "last": first, "done": done,
+            "seed": seed, "max_new": max_new, "temp": temp,
+        }
+
+    def _decode_step(self, params, state):
+        """One decode iteration over every lane: process ``last`` at cache
+        index ``pos`` (writing its K/V), sample the token for pos+1.
+        Finished (and free, zero-initialized) lanes freeze via ``done``."""
+        kc, vc = state["k"], state["v"]
+        b = kc.shape[0]
+        ln, h, hd, c = self.layers, self.heads, self.head_dim, self.max_ctx
+        dt = self.dtype
+        pos = state["pos"]
+        rows = jnp.arange(b)
+        x = (jnp.take(params["embed"], state["last"], axis=0)
+             + jnp.take(params["pos"], jnp.clip(pos, 0, c - 1), axis=0)
+             ).astype(dt)
+        mask = (jnp.arange(c)[None, :] > pos[:, None]) * jnp.float32(-1e9)
+        for i in range(ln):
+            lp = params[f"layer{i}"]
+            hx = _norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+            q = (hx @ lp["wq"].astype(dt)).reshape(b, h, hd)
+            k = (hx @ lp["wk"].astype(dt)).reshape(b, h, hd)
+            v = (hx @ lp["wv"].astype(dt)).reshape(b, h, hd)
+            kc = kc.at[rows, i, jnp.clip(pos, 0, c - 1)].set(k)
+            vc = vc.at[rows, i, jnp.clip(pos, 0, c - 1)].set(v)
+            s = (jnp.einsum("bhd,bchd->bhc", q, kc[:, i])
+                 .astype(jnp.float32) * (hd ** -0.5)) + mask[:, None, :]
+            a = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("bhc,bchd->bhd", a, vc[:, i]).reshape(b, h * hd)
+            x = x + o @ lp["wo"].astype(dt)
+            hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
+            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
+                     @ lp["w_down"].astype(dt))
+        logits = self._logits(params, x[:, None, :])[:, 0, :]
+        sampled = self._sample(logits, state["seed"],
+                               jnp.clip(pos + 1, 0, c - 1), state["temp"])
+        done = state["done"]
+        n_new = state["n_new"]
+        write_idx = jnp.clip(n_new, 0, self.max_new - 1)
+        tokens = state["tokens"].at[rows, write_idx].set(
+            jnp.where(done, state["tokens"][rows, write_idx], sampled))
+        n_new2 = jnp.where(done, n_new, n_new + 1)
+        done2 = done | (sampled == self.eos_id) | (n_new2 >= state["max_new"])
+        new_state = {
+            "k": kc, "v": vc,
+            "pos": jnp.where(done, pos, jnp.clip(pos + 1, 0, c - 1)),
+            "tokens": tokens,
+            "n_new": n_new2,
+            "last": jnp.where(done, state["last"], sampled),
+            "done": done2,
+            "seed": state["seed"], "max_new": state["max_new"],
+            "temp": state["temp"],
+        }
+        return new_state, {"done": done2, "n_new": n_new2}
+
+    # -- one-shot path (locked batch: static batcher + bench baseline) --------
+    def forward(self, params: Any, batch: Any) -> dict:
+        ids, n, seed, max_new, temp = batch
+        state = self._prefill(params, ids, n, seed, max_new, temp)
+
+        def body(_, st):
+            st2, _out = self._decode_step(params, st)
+            return st2
+
+        # The locked batch runs the FULL cap for every lane — max_new only
+        # freezes a lane's outputs, never shortens the loop. That cost gap
+        # is precisely what the iteration-level engine removes.
+        state = jax.lax.fori_loop(0, self.max_new - 1, body, state)
+        return {"tokens": state["tokens"], "n_new": state["n_new"]}
+
+    # -- engine decomposition (tpuserve.genserve) ------------------------------
+    def init_state(self, params: Any, item: Any) -> Any:
+        ids, n, seed, max_new, temp = item
+        state = self._prefill(params, ids[None], n[None], seed[None],
+                              max_new[None], temp[None])
+        return jax.tree_util.tree_map(lambda x: x[0], state)
+
+    def step(self, params: Any, state: Any) -> tuple[Any, dict]:
+        return self._decode_step(params, state)
+
+    def extract(self, params: Any, state: Any, slot: Any) -> Any:
+        idx = jax.lax.dynamic_index_in_dim
+        return {
+            "tokens": idx(state["tokens"], slot, 0, keepdims=False),
+            "n_new": idx(state["n_new"], slot, 0, keepdims=False),
+        }
+
+    def gen_max_steps(self) -> int:
+        return self.max_new
+
+    # -- host side ------------------------------------------------------------
+    def host_decode(self, payload: bytes, content_type: str) -> Any:
+        if content_type.startswith("application/json"):
+            body = json.loads(payload.decode("utf-8"))
+            prompt = body.get("prompt")
+            if not isinstance(prompt, str):
+                raise ValueError('JSON body must contain "prompt": str')
+            seed = int(body.get("seed", 0))
+            max_new = int(body.get("max_new_tokens", self.max_new))
+            temp = float(body.get("temperature", 0.0))
+        else:
+            prompt, seed, max_new, temp = payload.decode("utf-8"), 0, \
+                self.max_new, 0.0
+        if not 1 <= max_new <= self.max_new:
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self.max_new}], "
+                f"got {max_new}")
+        if temp < 0:
+            raise ValueError(f"temperature must be >= 0, got {temp}")
+        tok = self.tokenizer
+        pieces = tok.tokenize(prompt)
+        ids = [tok.vocab.get(t, tok.unk_id) for t in pieces][: self.max_prompt]
+        ids = ids or [tok.cls_id]  # an empty prompt still needs one position
+        arr = np.full((self.max_prompt,), tok.pad_id, np.int32)
+        arr[: len(ids)] = ids
+        # Every sampling parameter is part of the item ON PURPOSE: the
+        # result cache digests the whole tuple, so (prompt, seed=1) and
+        # (prompt, seed=2) can never share a key (ISSUE 9 satellite).
+        return (arr, np.int32(len(ids)), np.int32(seed), np.int32(max_new),
+                np.float32(temp))
+
+    def canary_item(self) -> Any:
+        return self.host_decode(
+            b'{"prompt": "canary", "seed": 1, "max_new_tokens": 2}',
+            "application/json")
+
+    def detokenize(self, token_ids: "list[int]") -> str:
+        """WordPiece pieces back to text: '##' continuations merge, EOS and
+        pads drop."""
+        inv = self.tokenizer.inv
+        words: list[str] = []
+        for t in token_ids:
+            piece = inv.get(int(t), "")
+            if not piece or piece in ("[SEP]", "[PAD]", "[CLS]"):
+                continue
+            if piece.startswith("##") and words:
+                words[-1] += piece[2:]
+            else:
+                words.append(piece)
+        return " ".join(words)
+
+    def _result(self, tokens: np.ndarray, n_new: int) -> dict:
+        toks = [int(t) for t in np.asarray(tokens)[: int(n_new)]]
+        return {"text": self.detokenize(toks), "tokens": toks,
+                "n_tokens": len(toks)}
+
+    def finalize(self, extracted: Any, item: Any) -> Any:
+        return self._result(extracted["tokens"], int(extracted["n_new"]))
+
+    def result_units(self, result: Any) -> float:
+        """Tokens generated — the tokens/s headline unit."""
+        return float(result.get("n_tokens", 1))
+
+    def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
+        return [self._result(outputs["tokens"][r], outputs["n_new"][r])
+                for r in range(n_valid)]
+
+
+def create(cfg: ModelConfig) -> TextGenServing:
+    return TextGenServing(cfg)

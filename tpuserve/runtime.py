@@ -124,6 +124,21 @@ class Variant:
         }
 
 
+@dataclass
+class GenProgram:
+    """One registered generative program (tpuserve.genserve): an AOT-compiled
+    jittable of ``(params, *args)`` that is NOT a forward bucket — the
+    engine's insert/step/extract executables. Registered in the same
+    VariantKey registry as forward buckets (bucket = (tag, width)), counted
+    by the same ``runtime_compiles_total``, so the zero-steady-state-
+    recompile obligation covers slot churn and reloads in one counter."""
+
+    tag: str
+    compiled: Any  # jax.stages.Compiled
+    donated: bool = False
+    counter: Any = None  # prebound runtime_variant_batches_total{variant=}
+
+
 def _leaves_with_shardings(struct: Any, shardings: Any) -> list[tuple]:
     """Pair a ShapeDtypeStruct tree's leaves with their shardings;
     ``shardings`` may be one NamedSharding broadcast over the tree."""
@@ -254,6 +269,13 @@ class ModelRuntime:
         # objects — the registry adds identity and accounting, not a copy).
         self.variants: dict[VariantKey, Variant] = {}
         self.executables: dict[tuple, list[Executable]] = {}
+        # Generative programs (tpuserve.genserve): tag -> GenProgram. Kept
+        # off the forward hot-path view but inside the variant registry.
+        self.gen_programs: dict[str, GenProgram] = {}
+        # False when this runtime backs an iteration-level engine: the
+        # engine's programs replace the forward bucket executables, so
+        # compile_all/ensure_compiled must not build (or re-demand) them.
+        self.compile_forward = True
         # Per-bucket raw-executable time (ms/batch), measured by
         # probe_raw_ms with inputs already resident — the device-time term
         # of the roofline's compute split (docs/PERFORMANCE.md).
@@ -436,6 +458,12 @@ class ModelRuntime:
         versions, which stage_params enforces) this is a cheap no-op whose
         return value of 0 is itself the steady-state proof."""
         new = 0
+        if not self.compile_forward:
+            # Engine-backed runtime: the generative programs were all
+            # registered at engine compile time and shapes never change
+            # across versions, so there is nothing to demand here — the
+            # 0 return IS the steady-state proof for the gen path.
+            return new
         for b in self.model.buckets():
             if self.variant_key(tuple(b)) not in self.variants:
                 self._compile_bucket(tuple(b))
@@ -449,9 +477,13 @@ class ModelRuntime:
         return self._c_compiles.value
 
     def variants_summary(self) -> list[dict]:
-        """Cheap enumeration of every resident compiled variant."""
+        """Cheap enumeration of every resident compiled variant. The sort
+        key stringifies bucket elements: forward buckets are int tuples,
+        generative programs (tag, width) tuples, and Python refuses to
+        order str against int."""
         return [v.summary() for _, v in sorted(
-            self.variants.items(), key=lambda kv: kv[0].bucket)]
+            self.variants.items(),
+            key=lambda kv: tuple(str(x) for x in kv[0].bucket))]
 
     def _compile_bucket(self, bucket: tuple) -> None:
         t0 = time.perf_counter()
@@ -516,6 +548,87 @@ class ModelRuntime:
         self._c_variant_batches[bucket] = self.metrics.counter(
             f"runtime_variant_batches_total{{model={self.model.name},"
             f"variant={key.label}}}")
+
+    # -- generative programs (tpuserve.genserve) ------------------------------
+    def register_program(self, tag: str, fn, arg_structs: tuple,
+                         width: int = 0,
+                         donate_argnums: tuple = ()) -> GenProgram:
+        """AOT-compile ``fn(params, *args)`` against the live param
+        structure and register it in the specialized-variant registry.
+
+        The iteration-level engine's executables (insert / step / extract)
+        go through here so they get the same discipline as forward buckets:
+        a frozen VariantKey identity (bucket = (tag, width) — enumerable in
+        /v1/models and /stats), a ``runtime_compiles_total`` tick per
+        compile (the zero-steady-state-recompile proof covers them), and a
+        prebound per-variant serving counter ticked by run_program.
+        Weight versions stay out of the key exactly as for forward buckets:
+        publish/rollback swap trees under unchanged shapes, so every
+        version reuses the registered program.
+
+        v1 composes with single-mesh layouts only ("single"/"sharded" —
+        the engine owns one device state block); ``arg_structs`` leaves are
+        replicated (P()) onto the mesh, params keep their partition-rule
+        shardings. ``donate_argnums`` indexes into ``args`` (0 = the first
+        arg after params) and is honored off-CPU only — on the CPU backend
+        device_put may alias host memory (the assembly-arena rule)."""
+        if len(self.meshes) != 1:
+            raise ValueError(
+                f"{self.model.name}: generative programs need a single-mesh "
+                f"layout (parallelism 'single' or 'sharded'); "
+                f"{self.mode!r} has {len(self.meshes)} meshes")
+        mesh = self.meshes[0]
+        params = self.params_per_mesh[0]
+        t0 = time.perf_counter()
+        param_shardings = jax.tree_util.tree_map(lambda x: x.sharding, params)
+        params_struct = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), params)
+        repl = NamedSharding(mesh, P())
+        arg_shardings = tuple(
+            jax.tree_util.tree_map(lambda _s: repl, struct)
+            for struct in arg_structs)
+        donate = ()
+        if donate_argnums and jax.default_backend() != "cpu":
+            donate = tuple(1 + i for i in donate_argnums)
+        jitted = jax.jit(fn, in_shardings=(param_shardings, *arg_shardings),
+                         donate_argnums=donate)
+        compiled = jitted.lower(params_struct, *arg_structs).compile()
+        prog = GenProgram(tag, compiled, donated=bool(donate))
+        self.gen_programs[tag] = prog
+        key = self.variant_key((tag, width))
+        self.variants[key] = Variant(
+            key, [Executable((tag, width), compiled,
+                             batch_sharding=arg_shardings,
+                             donated=bool(donate))],
+            compile_ms=(time.perf_counter() - t0) * 1e3)
+        self._c_compiles.inc()
+        self._g_variants.set(len(self.variants))
+        prog.counter = self._c_variant_batches[(tag, width)] = \
+            self.metrics.counter(
+                f"runtime_variant_batches_total{{model={self.model.name},"
+                f"variant={key.label}}}")
+        return prog
+
+    def run_program(self, tag: str, *args,
+                    params_override: "list[Any] | None" = None) -> Any:
+        """Async-dispatch a registered generative program against the LIVE
+        param tree (or a staged candidate via ``params_override`` — the
+        lifecycle's staged canary runs a short generation through the real
+        compiled programs without the candidate ever serving). The params
+        list is snapshotted per call, so every dispatch is version-
+        consistent and a mid-flight publish affects only later iterations."""
+        if self.injector is not None:
+            delay = self.injector.delay_s("slow_compute", self.model.name)
+            if delay > 0:
+                time.sleep(delay)  # runs on a stage executor thread
+            self.injector.check("device_error", self.model.name)
+        prog = self.gen_programs[tag]
+        if prog.counter is not None:
+            prog.counter.inc()
+        params = (params_override if params_override is not None
+                  else self.params_per_mesh)
+        return prog.compiled(params[0], *args)
 
     # -- hot path -----------------------------------------------------------
     @property
@@ -837,8 +950,15 @@ class ModelRuntime:
 def build_runtime(model: ServingModel, mesh: Mesh | None = None,
                   pool: cf.ThreadPoolExecutor | None = None,
                   metrics: Metrics | None = None,
-                  parallel: ParallelConfig | None = None) -> ModelRuntime:
+                  parallel: ParallelConfig | None = None,
+                  compile_forward: bool = True) -> ModelRuntime:
+    """``compile_forward=False`` builds a params-only runtime for an
+    iteration-level engine (tpuserve.genserve): the engine registers its
+    insert/step/extract programs instead of the forward bucket set, so
+    compiling both would double startup compile time for nothing."""
     rt = ModelRuntime(model, mesh, metrics=metrics, parallel=parallel)
+    rt.compile_forward = compile_forward
     rt.load_and_shard_params()
-    rt.compile_all(pool)
+    if compile_forward:
+        rt.compile_all(pool)
     return rt

@@ -53,6 +53,7 @@ from tpuserve.bench.roofline import compute_split, phase_p50
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
+from tpuserve.genserve import GenEngine
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
 from tpuserve.obs import Metrics
@@ -112,7 +113,14 @@ class ServerState:
         self.stages = StageExecutors(cfg.pipeline, self.metrics)
         self.models: dict[str, object] = {}
         self.runtimes: dict[str, ModelRuntime] = {}
-        self.batchers: dict[str, ModelBatcher] = {}
+        # Per-model dispatch engine: ModelBatcher (one-shot locked batches)
+        # or GenEngine (iteration-level continuous batching) — both expose
+        # the same submit/start/stop/drain/revive surface, so every caller
+        # below (canaries, drain, watchdog, handle_predict) is agnostic.
+        self.batchers: "dict[str, ModelBatcher | GenEngine]" = {}
+        # The GenEngine subset of batchers (feeds the /stats genserve block;
+        # built in build() so program compilation happens at startup).
+        self.engines: dict[str, GenEngine] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
         # Versioned reload lifecycle (tpuserve.lifecycle); direct-mode
         # runtimes only — recycle-mode workers own their params.
@@ -174,6 +182,23 @@ class ServerState:
                     rt = DeferredPool(mcfg, self.cfg.compilation_cache_dir,
                                       model, injector=self.injector)
                     rt.prewarm()
+                elif self.cfg.genserve.enabled \
+                        and getattr(model, "generative", False):
+                    # Iteration-level engine (docs/PERFORMANCE.md "The
+                    # generation engine"): the engine's insert/step/extract
+                    # programs replace the forward bucket set — compiling
+                    # both would double startup compile time for nothing.
+                    rt = build_runtime(model, metrics=self.metrics,
+                                       parallel=self.cfg.parallel,
+                                       compile_forward=False)
+                    eng = GenEngine(model, rt, self.metrics,
+                                    self.cfg.genserve, stages=self.stages,
+                                    pipeline_cfg=self.cfg.pipeline)
+                    eng.compile()  # registers + prewarms the programs
+                    self.engines[mcfg.name] = eng
+                    # Armed after compile/prewarm, like the batcher path.
+                    eng.injector = self.injector
+                    rt.injector = self.injector
                 else:
                     rt = build_runtime(model, pool=compile_pool,
                                        metrics=self.metrics,
@@ -212,18 +237,29 @@ class ServerState:
                                 self.metrics,
                                 retry_after_s=model.cfg.breaker_retry_after_s)
             self.breakers[name] = br
-            b = ModelBatcher(model, rt, self.metrics, self.pool,
-                             breaker=br, injector=self.injector,
-                             stages=self.stages,
-                             pipeline_cfg=self.cfg.pipeline,
-                             adaptive_cfg=self.cfg.adaptive)
-            await b.start()
+            eng = self.engines.get(name)
+            if eng is not None:
+                # Iteration-level engine: same front-door surface as the
+                # batcher, so everything below (canary, cache, watchdog,
+                # lifecycle, drain) composes unchanged.
+                eng.breaker = br
+                await eng.start()
+                b: "ModelBatcher | GenEngine" = eng
+            else:
+                b = ModelBatcher(model, rt, self.metrics, self.pool,
+                                 breaker=br, injector=self.injector,
+                                 stages=self.stages,
+                                 pipeline_cfg=self.cfg.pipeline,
+                                 adaptive_cfg=self.cfg.adaptive)
+                await b.start()
             self.batchers[name] = b
             self.handles[name] = ModelHandles(name, model.cfg, self.metrics)
-            if self.cfg.cache.enabled:
+            if self.cfg.cache.enabled and getattr(model, "cacheable", True):
                 # Keys carry the LIVE runtime version, so a lifecycle
                 # publish/rollback atomically invalidates older entries;
                 # recycle-mode pools have no in-process version and pin 0.
+                # Models with cacheable = false never get a cache: their
+                # results are not a pure function of the decoded item.
                 self.caches[name] = ModelCache(
                     name, self.cfg.cache, self.metrics,
                     version_fn=functools.partial(getattr, rt, "version", 0))
@@ -232,13 +268,18 @@ class ServerState:
                 self.watchdog.register(name, "worker", rt.watchdog_sweep)
             if hasattr(rt, "stage_params"):
                 # functools.partial, not a lambda: late binding would hand
-                # every lifecycle the last loop iteration's name.
+                # every lifecycle the last loop iteration's name. Engine
+                # models swap in the engine's staged canary: a SHORT
+                # generation end-to-end through the real compiled programs
+                # against the candidate tree.
                 self.lifecycles[name] = ModelLifecycle(
                     name, rt, model, self.cfg.lifecycle, self.metrics,
                     breaker=br,
                     canary=functools.partial(self.run_canary, name),
                     canary_status=functools.partial(self.canary_ok.get, name),
-                    injector=self.injector)
+                    injector=self.injector,
+                    staged_canary_fn=eng.staged_canary_sync
+                    if eng is not None else None)
         if self.cfg.startup_canary:
             await self.run_canaries()
         if self.cfg.canary_interval_s > 0:
@@ -680,6 +721,12 @@ async def handle_stats(request: web.Request) -> web.Response:
     parallel = state.parallel_stats()
     if parallel:
         out["parallel"] = parallel
+    # Iteration-level generation engines (docs/PERFORMANCE.md "The
+    # generation engine"): slot occupancy, fold-in/early-exit/eviction
+    # counts, step timing — per engine-served model.
+    if state.engines:
+        out["genserve"] = {n: e.pipeline_stats()
+                           for n, e in state.engines.items()}
     # Demand-shaping layer: per-model result-cache occupancy and the
     # hit/miss/coalesced/stale accounting (docs/PERFORMANCE.md).
     if state.caches:

@@ -159,7 +159,12 @@ class RouterState:
                 name, mcfg.breaker_threshold, self.metrics,
                 retry_after_s=mcfg.breaker_retry_after_s)
             self.generations[name] = 1
-            if cfg.cache.enabled:
+            # cacheable = false keeps a model out of the router's
+            # wire-level cache too: the wire key digests the raw body, so
+            # only models whose results are a pure function of the body
+            # (every sampling param — seed, temperature, steps — rides IN
+            # the body for the generative families) may populate it.
+            if cfg.cache.enabled and mcfg.cacheable:
                 self.caches[name] = ModelCache(
                     name, cfg.cache, self.metrics,
                     version_fn=functools.partial(self.generations.get, name, 0))
