@@ -41,4 +41,7 @@ scripts/multichip_smoke.sh
 echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
 scripts/worker_drill.sh
 
+echo "== fleet drill (poison one model @ 100%, survivors hold >= 99%) =="
+scripts/fleet_drill.sh
+
 echo "chaos smoke OK"

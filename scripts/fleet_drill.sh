@@ -1,0 +1,108 @@
+#!/usr/bin/env bash
+# Fleet isolation drill (ISSUE 10): one REAL multi-model server with the
+# fleet scheduler armed, closed-loop load on every model concurrently,
+# and one model poisoned with device_error @ 100% (docs/ROBUSTNESS.md
+# "Fleet isolation & SLO admission"):
+#   1. the victim's circuit breaker opens (its traffic degrades to fast
+#      503s, not slow 500s);
+#   2. every SURVIVOR holds availability >= 99% with p99 within budget —
+#      the poisoned model's failing dispatches never starve the others;
+#   3. zero lock-order findings: the whole run is witnessed.
+# A second leg proves the warm/cold weight-paging contract end-to-end:
+# a cold-declared model boots with zero device params, serves after
+# staging, idle-demotes, and re-warms with a runtime_compiles_total
+# delta of 0. Runs the real `python -m tpuserve chaos --drill fleet`
+# CLI; wired into chaos_smoke.sh and CI next to the other drills.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): scheduler,
+# batchers, and the load all run under witnessed locks.
+export TPUSERVE_LOCK_WITNESS=1
+
+CFG="$(mktemp /tmp/tpuserve_fleet_drill.XXXXXX.toml)"
+OUT="$(mktemp /tmp/tpuserve_fleet_drill.XXXXXX.json)"
+trap 'rm -f "$CFG" "$OUT"' EXIT
+
+cat > "$CFG" <<'EOF'
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+
+[scheduler]
+enabled = true
+
+[[model]]
+name = "victim"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+breaker_threshold = 3
+
+[[model]]
+name = "survivor_a"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+
+[[model]]
+name = "survivor_b"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+EOF
+
+echo "== fleet drill (device_error @ 100% on 'victim', 3-model closed loop) =="
+python -m tpuserve chaos --config "$CFG" --drill fleet --model victim \
+    --duration 10 --warmup 1 --concurrency 6 \
+    --min-availability 0.99 | tee "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, sys
+
+s = json.load(open(sys.argv[1]))
+assert s["drill"] == "fleet" and s["victim"] == "victim"
+assert s["victim_breaker_open"], \
+    f"victim breaker must open: {s['victim_breaker']}"
+assert s["availability"] >= 0.99, \
+    f"worst survivor availability {s['availability']}"
+p99_budget_ms = 2000.0
+for name, row in s["models"].items():
+    if row["role"] != "survivor":
+        continue
+    assert row["availability"] >= 0.99, (name, row["availability"])
+    assert row["n_ok"] > 0, (name, "served nothing")
+    assert row["p99_ms"] <= p99_budget_ms, (name, row["p99_ms"])
+assert s["models"]["victim"]["availability"] < 0.5, \
+    "the poison must actually be hitting the victim"
+assert any(f["kind"] == "device_error" and f["fired"] > 0
+           for f in s["faults"]), s["faults"]
+assert s["scheduler"]["models"]["victim"]["state"] == "warm"
+print("fleet drill OK: victim breaker "
+      f"{s['victim_breaker']['state']}, worst survivor availability "
+      f"{s['availability']}, survivor p99s "
+      + str({n: r["p99_ms"] for n, r in s["models"].items()
+             if r["role"] == "survivor"}))
+EOF
+
+echo "== weight paging (cold boot -> warm -> idle demote -> zero-recompile re-warm) =="
+python -m pytest -q -p no:cacheprovider \
+    tests/test_scheduler.py::test_cold_start_warm_demote_rewarm_zero_recompiles \
+    tests/test_scheduler.py::test_warm_endpoint_http
+
+echo "fleet drill OK"

@@ -354,6 +354,63 @@ def test_router_cache_hit_and_single_execution(fleet):
     run(go())
 
 
+def test_priority_relayed_end_to_end_and_not_in_cache_key(fleet):
+    """ISSUE 10 satellite: X-Priority rides header -> worker -> batcher
+    (the worker's queue-wait split records the relayed class), and the
+    router's wire cache key NEVER sees it — same bytes, same entry,
+    whatever the priority."""
+    run, session, base, state = fleet
+
+    async def go():
+        body = npy(777)
+        qkey = 'queue_wait_ms_count{model="toy",priority="batch"}'
+        before_q = await _worker_metric_sum(session, base, qkey)
+        before_req = await _worker_metric_sum(
+            session, base, 'requests_total{model="toy"}')
+        async with session.post(
+                f"{base}/v1/models/toy:classify", data=body,
+                headers={"Content-Type": NPY, "X-Priority": "batch"}) as r:
+            assert r.status == 200, await r.text()
+            first = await r.read()
+        after_q = await _worker_metric_sum(session, base, qkey)
+        assert after_q - before_q == 1, \
+            "relayed X-Priority must reach the worker's batcher split"
+        # Same bytes, DIFFERENT priority: must hit the router cache — no
+        # second worker execution, byte-identical answer.
+        async with session.post(
+                f"{base}/v1/models/toy:classify", data=body,
+                headers={"Content-Type": NPY,
+                         "X-Priority": "interactive"}) as r:
+            assert r.status == 200
+            assert await r.read() == first
+        after_req = await _worker_metric_sum(
+            session, base, 'requests_total{model="toy"}')
+        assert after_req - before_req == 1, \
+            "priority must not enter the cache key (same bytes, same key)"
+
+    run(go())
+
+
+def test_router_records_worker_shed_reason():
+    """The router remembers the machine-readable `reason` workers answer
+    on scheduler sheds, and carries it on its own breaker 503s."""
+    from tpuserve.workerproc.router import RouterState, _Answer
+
+    cfg = ServerConfig(models=[_toy("toy")],
+                       router=RouterConfig(enabled=True, workers=1))
+    state = RouterState(cfg)
+    state.note_shed_reason("toy", _Answer(
+        503, "application/json",
+        b'{"error": "warming", "reason": "model_warming"}', None))
+    assert state.last_shed_reason["toy"] == "model_warming"
+    # Non-shed statuses and junk bodies never overwrite it.
+    state.note_shed_reason("toy", _Answer(200, "application/json",
+                                          b'{"reason": "nope"}', None))
+    state.note_shed_reason("toy", _Answer(503, "text/plain",
+                                          b"not json", None))
+    assert state.last_shed_reason["toy"] == "model_warming"
+
+
 def test_deadline_expires_inside_worker(fleet):
     """Deadline propagation (ISSUE 8 satellite): the router stamps the
     absolute deadline at admission and forwards the remaining budget; a

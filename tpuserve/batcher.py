@@ -66,7 +66,7 @@ from typing import Any, Hashable
 from tpuserve.config import AdaptiveConfig, PipelineConfig
 from tpuserve.hostpipe import AssemblyArena, SlotPool, StageExecutors
 from tpuserve.models.base import ServingModel
-from tpuserve.obs import PHASES, Metrics
+from tpuserve.obs import PHASES, PRIORITIES, Metrics
 from tpuserve.runtime import ModelRuntime
 
 log = logging.getLogger("tpuserve.batcher")
@@ -74,6 +74,18 @@ log = logging.getLogger("tpuserve.batcher")
 
 class QueueFull(Exception):
     """Raised by submit() when the model queue is at capacity (-> HTTP 429)."""
+
+
+def clamp_retry_after_s(est: "float | None") -> "int | None":
+    """The [1, 30] s Retry-After hint derived from a raw queue-clear
+    estimate. Deliberately split from ``estimate_clear_s`` (ISSUE 10
+    satellite): the clamp is a client-facing hint policy, not a property of
+    the estimate — the fleet scheduler's admission math needs the RAW
+    number (clamping a 90 s backlog to 30 s would admit work that provably
+    cannot meet a 45 s deadline)."""
+    if est is None:
+        return None
+    return max(1, min(30, math.ceil(est)))
 
 
 class DeadlineExceeded(Exception):
@@ -91,6 +103,9 @@ class _Request:
     # Absolute per-request deadline (perf_counter clock), stamped at
     # admission from the client's timeout_ms; None = model default only.
     deadline_at: float | None = None
+    # Priority class ("interactive"/"batch"; obs.PRIORITIES) resolved at
+    # admission from X-Priority or the model default; None = unscheduled.
+    priority: str | None = None
 
 
 class ModelBatcher:
@@ -148,6 +163,15 @@ class ModelBatcher:
         self._h_phase = {
             p: metrics.histogram(f"latency_ms{{model={name},phase={p}}}")
             for p in PHASES}
+        # Per-priority queue-wait split (tpuserve.scheduler): requests
+        # without a resolved priority land under the model's default class.
+        self._default_priority = getattr(model.cfg, "priority", "interactive")
+        self._h_qwait = {p: metrics.queue_wait_histogram(name, p)
+                         for p in PRIORITIES}
+        # Fleet-scheduler device-time ledger hook: called with each batch's
+        # device-section seconds (compute phase) when a scheduler is
+        # attached; None otherwise. Event-loop-only, like the ledger.
+        self.device_time_cb = None
         # Stage executors are normally server-owned and shared across models
         # (stage-granularity scheduling); a batcher built without one (tests,
         # embedding) creates and later shuts down its own.
@@ -259,12 +283,16 @@ class ModelBatcher:
 
     # -- submission (event loop) --------------------------------------------
     def submit(self, item: Any, group: Hashable = None,
-               deadline_at: float | None = None) -> asyncio.Future:
+               deadline_at: float | None = None,
+               priority: str | None = None) -> asyncio.Future:
         """Enqueue one decoded request; returns a Future of its result.
 
         ``deadline_at`` (perf_counter clock) is the request's absolute
         deadline: if it expires while the request is still queued, the
-        future fails with DeadlineExceeded instead of dispatching."""
+        future fails with DeadlineExceeded instead of dispatching.
+        ``priority`` labels the request's queue-wait histogram (the fleet
+        scheduler's arbitration happened BEFORE submit — by here the
+        request is admitted either way)."""
         if not self._running or self._inflight is None:
             raise RuntimeError(f"batcher for {self.model.name} not started")
         if self._pending >= self.cfg.max_queue:
@@ -273,7 +301,8 @@ class ModelBatcher:
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         req = _Request(item=item, group=group, future=fut,
-                       enqueued_at=time.perf_counter(), deadline_at=deadline_at)
+                       enqueued_at=time.perf_counter(), deadline_at=deadline_at,
+                       priority=priority)
         q = self._queues.get(group)
         if q is None:
             q = self._queues[group] = asyncio.Queue()
@@ -509,7 +538,10 @@ class ModelBatcher:
                 continue
             now = time.perf_counter()
             for r in live:
-                self._h_phase["queue"].observe((now - r.enqueued_at) * 1e3)
+                wait_ms = (now - r.enqueued_at) * 1e3
+                self._h_phase["queue"].observe(wait_ms)
+                self._h_qwait[r.priority or self._default_priority].observe(
+                    wait_ms)
             task = asyncio.get_running_loop().create_task(self._dispatch(live, group))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._dispatch_tasks.discard)
@@ -651,6 +683,8 @@ class ModelBatcher:
                 np_out = await out_fut
                 t3 = time.perf_counter()
                 self._h_phase["compute"].observe((t3 - t2) * 1e3)
+                if self.device_time_cb is not None:
+                    self.device_time_cb(t3 - t2)
             else:
                 # Device section: a staging slot bounds batches inside
                 # [h2d..fetch] to depth-k per replica; the wait is
@@ -681,6 +715,10 @@ class ModelBatcher:
                         name, "fetch", self.runtime.fetch, outputs)
                     t3 = time.perf_counter()
                     self._h_phase["compute"].observe((t3 - t2) * 1e3)
+                    if self.device_time_cb is not None:
+                        # Fleet device-time ledger: the device section
+                        # (dispatch-to-ready) is what models compete for.
+                        self.device_time_cb(t3 - t2)
                 finally:
                     self._release_staging(replica, slot)
         finally:
@@ -746,10 +784,38 @@ class ModelBatcher:
         await run_split(reqs)
 
     # -- introspection -------------------------------------------------------
+    @property
+    def pending(self) -> int:
+        """Requests accepted but not yet flushed into a batch (the
+        scheduler's demand signal and the idle-demotion guard)."""
+        return self._pending
+
+    def predicted_service_s(self, n_items: int = 1) -> float | None:
+        """Predicted seconds of service time for a request of ``n_items``
+        once it reaches the front of the queue: the batch-duration EWMA of
+        the smallest bucket that covers it (Clockwork P3 — duration is
+        predictable per (model, bucket)). Falls back to the largest
+        observed bucket when nothing that small has run; None before any
+        batch has completed."""
+        if not self._ewma_ms:
+            return None
+        covering = [(b, ms) for b, ms in self._ewma_ms.items()
+                    if ms > 0 and b[0] >= n_items]
+        if covering:
+            _, ms = min(covering, key=lambda kv: kv[0][0])
+        else:
+            _, ms = max(self._ewma_ms.items(), key=lambda kv: kv[0][0])
+            if ms <= 0:
+                return None
+        return ms / 1e3
+
     def estimate_clear_s(self) -> float | None:
         """Estimated seconds for the current queue to clear at the observed
-        serving rate — the live ``Retry-After`` basis for queue-full 429s
-        (docs/ROBUSTNESS.md). Rate = the best items/s any bucket has
+        serving rate. Deliberately UNCLAMPED (ISSUE 10 satellite): the
+        fleet scheduler's admission math consumes this raw number;
+        ``clamp_retry_after_s`` derives the [1, 30] s client-facing
+        Retry-After hint for queue-full 429s from it (docs/ROBUSTNESS.md).
+        Rate = the best items/s any bucket has
         demonstrated (its size over its batch-duration EWMA), so the hint
         tracks what the device is actually doing instead of a constant.
         None before any batch has completed (no EWMA yet) or with an empty

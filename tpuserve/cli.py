@@ -124,15 +124,19 @@ def main(argv: list[str] | None = None) -> int:
                          help="open-loop offered rate (req/s); default closed loop")
     p_chaos.add_argument("--min-availability", type=float, default=0.0,
                          help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
-    p_chaos.add_argument("--drill", choices=["reload", "worker_kill"],
+    p_chaos.add_argument("--drill", choices=["reload", "worker_kill", "fleet"],
                          default=None,
                          help="additionally drive a drill during the run: "
                               "'reload' POSTs :reload on an interval so "
                               "reload_* fault rules prove the lifecycle "
                               "gates hold availability; 'worker_kill' "
                               "serves a real router + worker fleet and "
-                              "SIGKILLs one worker mid-load "
-                              "(docs/ROBUSTNESS.md)")
+                              "SIGKILLs one worker mid-load; 'fleet' loads "
+                              "every configured model (>= 3), poisons "
+                              "--model with device_error @ 100%, and "
+                              "reports per-model isolation — the victim's "
+                              "breaker must open while every survivor "
+                              "holds its SLO (docs/ROBUSTNESS.md)")
     p_chaos.add_argument("--drill-interval", type=float, default=0.5,
                          help="seconds between drill operations")
     p_chaos.add_argument("--kill-after", type=float, default=None,
@@ -205,6 +209,16 @@ def main(argv: list[str] | None = None) -> int:
                 cfg, model, duration_s=args.duration, warmup_s=args.warmup,
                 concurrency=args.concurrency, kill_after_s=args.kill_after,
                 respawn_budget_s=args.respawn_budget))
+        elif args.drill == "fleet":
+            # Isolation drill (Clipper P1): --model names the VICTIM; the
+            # gated availability is the WORST SURVIVOR's.
+            from tpuserve.parallel import init_distributed
+            from tpuserve.scheduler import run_fleet_drill
+
+            init_distributed(cfg.distributed)
+            summary = asyncio.run(run_fleet_drill(
+                cfg, victim=model, duration_s=args.duration,
+                warmup_s=args.warmup, concurrency=args.concurrency))
         else:
             from tpuserve.faults import run_chaos
             from tpuserve.parallel import init_distributed

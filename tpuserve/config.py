@@ -310,6 +310,64 @@ class ParallelConfig:
 
 
 @dataclass
+class SchedulerConfig:
+    """Fleet-level SLO scheduler (``[scheduler]`` TOML; tpuserve.scheduler,
+    docs/ROBUSTNESS.md "Fleet isolation & SLO admission").
+
+    Off by default — every model keeps its independent batcher with no
+    cross-model arbitration. When enabled, a central scheduler sits between
+    admission and the per-model batchers/engines (Clockwork, PAPERS.md P3):
+    requests whose stamped deadline provably cannot be met are shed at
+    admission with a fast 504 (``deadline_unmeetable``) instead of dying in
+    the queue; ``X-Priority: interactive|batch`` requests arbitrate device
+    time through a per-model device-seconds ledger (low-priority work sheds
+    first under overload, and no model's interactive traffic is starved
+    below ``min_share``); and models declared ``cold_start`` boot without
+    device params, warming through the lifecycle stage→publish path on
+    first request (or ``:warm``) and demoting back to cold after
+    ``idle_demote_s`` so more models than fit in HBM serve honestly."""
+
+    enabled: bool = False
+    # Sliding window (s) for the per-model device-seconds ledger that
+    # backs the priority-share arbitration.
+    window_s: float = 10.0
+    # The fleet counts as saturated (low-priority sheds, share floors
+    # enforce) when the aggregate predicted queue-clear time across warm
+    # models exceeds this many seconds.
+    overload_clear_s: float = 1.0
+    # Interactive floor: under saturation, a model with queued work whose
+    # windowed device-time share is below this is "starved", and models
+    # consuming more than their allowance (1 - min_share * others) shed
+    # until the starved model catches up. 0 disables the floor.
+    min_share: float = 0.05
+    # Grace (ms) a request gets beyond the predicted completion before the
+    # deadline_unmeetable shed fires — raise it to shed less eagerly when
+    # duration EWMAs are noisy.
+    headroom_ms: float = 0.0
+    # > 0: a warm cold_start model idle this long demotes back to cold,
+    # freeing its device params (HBM) until the next request re-warms it.
+    idle_demote_s: float = 0.0
+    # Retry-After hint (s) on warming-window 503s before the first warm-up
+    # has been measured (after that, the measured warm duration is used).
+    warm_retry_after_s: float = 5.0
+    # Idle-demotion sweep cadence (s).
+    sweep_interval_s: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.window_s <= 0 or self.sweep_interval_s <= 0:
+            raise ValueError(
+                "scheduler.window_s/sweep_interval_s must be > 0")
+        if not 0.0 <= self.min_share < 0.5:
+            raise ValueError(
+                f"scheduler.min_share must be in [0, 0.5), got {self.min_share}")
+        if self.overload_clear_s < 0 or self.headroom_ms < 0 \
+                or self.idle_demote_s < 0 or self.warm_retry_after_s < 0:
+            raise ValueError(
+                "scheduler.overload_clear_s/headroom_ms/idle_demote_s/"
+                "warm_retry_after_s must be >= 0")
+
+
+@dataclass
 class RouterConfig:
     """Router/worker process split (``[router]`` TOML; tpuserve.workerproc,
     docs/ROBUSTNESS.md "Process failure domains").
@@ -484,6 +542,17 @@ class ModelConfig:
     relay_epoch_ms: float = 2000.0
     # recycle mode: per-worker shared-memory batch slots (in-flight batches).
     relay_slots: int = 4
+    # Default priority class for requests that carry no X-Priority header
+    # ("interactive" or "batch"). Only consulted when the fleet scheduler
+    # ([scheduler] enabled) arbitrates: under overload, batch-class work
+    # sheds first (docs/ROBUSTNESS.md "Fleet isolation & SLO admission").
+    priority: str = "interactive"
+    # Fleet scheduler weight paging: True boots this model COLD — compiled
+    # variants and device params are not built/resident until the first
+    # request (or POST .../{name}:warm) stages them through the lifecycle
+    # path, and [scheduler] idle_demote_s can demote them back, freeing
+    # HBM. Requires [scheduler] enabled and session_mode = "direct".
+    cold_start: bool = False
     # Result-cache eligibility: False keeps this model out of every result
     # cache (server-side ModelCache AND the router tier's wire-level cache).
     # Generative families keep every sampling parameter (seed, temperature,
@@ -513,6 +582,14 @@ class ModelConfig:
         if self.tp < 1 or self.sp < 1:
             raise ValueError(
                 f"tp and sp must be >= 1, got tp={self.tp} sp={self.sp}")
+        if self.priority not in ("interactive", "batch"):
+            raise ValueError(
+                f"priority must be 'interactive' or 'batch', "
+                f"got {self.priority!r}")
+        if self.cold_start and self.session_mode != "direct":
+            raise ValueError(
+                "cold_start requires session_mode = 'direct' (recycle-mode "
+                "workers own their params out of process)")
 
 
 @dataclass
@@ -552,6 +629,10 @@ class ServerConfig:
     # static-bucket batcher serves everything, including generative models
     # as locked batches.
     genserve: GenserveConfig = field(default_factory=GenserveConfig)
+    # Fleet-level SLO scheduler: predictive admission, priority classes,
+    # warm/cold weight paging (docs/ROBUSTNESS.md "Fleet isolation & SLO
+    # admission"). Off by default.
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     # Router/worker process split: multi-process failure domains with
     # supervision + hedged retry (docs/ROBUSTNESS.md). Off by default.
     router: RouterConfig = field(default_factory=RouterConfig)
@@ -645,6 +726,7 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     dist_dict = raw.pop("distributed", None)
     parallel_dict = raw.pop("parallel", None)
     genserve_dict = raw.pop("genserve", None)
+    scheduler_dict = raw.pop("scheduler", None)
     router_dict = raw.pop("router", None)
     worker_dict = raw.pop("worker", None)
     faults_dict = raw.pop("faults", None)
@@ -660,6 +742,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
         cfg.parallel = _build(ParallelConfig, parallel_dict)
     if genserve_dict is not None:
         cfg.genserve = _build(GenserveConfig, genserve_dict)
+    if scheduler_dict is not None:
+        cfg.scheduler = _build(SchedulerConfig, scheduler_dict)
     if router_dict is not None:
         cfg.router = _build(RouterConfig, router_dict)
     if worker_dict is not None:

@@ -23,6 +23,11 @@ holds both sides of that story:
 - **CircuitBreaker**: per-model, trips to fast 503 + ``Retry-After`` after N
   consecutive failed dispatches; half-opens via the existing canary path
   (canaries keep riding the batcher while open; the first success closes).
+  The fleet isolation drill (``tpuserve chaos --drill fleet``,
+  tpuserve.scheduler.drill) poisons one model's dispatches with
+  ``device_error`` at 100% under multi-model load and asserts this breaker
+  contains the blast radius: the victim trips while every other model
+  holds its SLO.
 
 - **Watchdog**: periodic sweep that restarts dead group-accumulation tasks
   and reaps/replenishes dead deferred workers, with restart counters in

@@ -52,7 +52,7 @@ from tpuserve.config import GenserveConfig, PipelineConfig
 from tpuserve.genserve.arena import SlotArena, SlotInfo
 from tpuserve.genserve.model import GenerativeModel
 from tpuserve.hostpipe import StageExecutors
-from tpuserve.obs import Metrics
+from tpuserve.obs import PRIORITIES, Metrics
 
 log = logging.getLogger("tpuserve.genserve")
 
@@ -63,6 +63,9 @@ class _GenRequest:
     future: asyncio.Future = field(repr=False)
     enqueued_at: float = 0.0
     deadline_at: float | None = None
+    # Priority class resolved at admission (obs.PRIORITIES); None when the
+    # fleet scheduler is off.
+    priority: str | None = None
 
 
 class GenEngine:
@@ -113,6 +116,12 @@ class GenEngine:
         self._h_extract = metrics.histogram(f"gen_extract_ms{{model={name}}}")
         self._h_queue = metrics.histogram(
             f"latency_ms{{model={name},phase=queue}}")
+        self._default_priority = getattr(model.cfg, "priority", "interactive")
+        self._h_qwait = {p: metrics.queue_wait_histogram(name, p)
+                         for p in PRIORITIES}
+        # Fleet device-time ledger hook (tpuserve.scheduler): called with
+        # each compiled step's seconds when a scheduler is attached.
+        self.device_time_cb = None
         self._pending: collections.deque[_GenRequest] = collections.deque()
         self._state: Any = None
         self._state_struct: Any = None
@@ -255,10 +264,12 @@ class GenEngine:
 
     # -- submission (event loop) ----------------------------------------------
     def submit(self, item: Any, group: Any = None,
-               deadline_at: float | None = None) -> asyncio.Future:
+               deadline_at: float | None = None,
+               priority: str | None = None) -> asyncio.Future:
         """Enqueue one decoded request; returns a Future of its result.
         ``group`` is accepted for batcher-API parity and ignored — the
-        engine has one slot block, not per-group queues."""
+        engine has one slot block, not per-group queues. ``priority``
+        labels the queue-wait histogram (arbitration happened upstream)."""
         if not self._running or self._work_event is None:
             raise RuntimeError(f"engine for {self.name} not started")
         if len(self._pending) >= self.cfg.max_queue:
@@ -267,7 +278,7 @@ class GenEngine:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append(_GenRequest(
             item=item, future=fut, enqueued_at=time.perf_counter(),
-            deadline_at=deadline_at))
+            deadline_at=deadline_at, priority=priority))
         self._g_queue_depth.set(len(self._pending))
         self._idle_event.clear()
         self._work_event.set()
@@ -308,6 +319,8 @@ class GenEngine:
                 step_ms = (time.perf_counter() - t0) * 1e3
                 self._h_step.observe(step_ms)
                 self._observe_step(step_ms)
+                if self.device_time_cb is not None:
+                    self.device_time_cb(step_ms / 1e3)
                 self._c_iterations.inc()
             except asyncio.CancelledError:
                 raise
@@ -402,7 +415,10 @@ class GenEngine:
                             deadline_at=req.deadline_at,
                             enqueued_at=req.enqueued_at, admitted_at=now)
             slot = self.arena.acquire(info)
-            self._h_queue.observe((now - req.enqueued_at) * 1e3)
+            wait_ms = (now - req.enqueued_at) * 1e3
+            self._h_queue.observe(wait_ms)
+            self._h_qwait[req.priority or self._default_priority].observe(
+                wait_ms)
             t0 = time.perf_counter()
             try:
                 await self.stages.run(self.name, "h2d", self._insert_sync,
@@ -544,8 +560,25 @@ class GenEngine:
         self._ewma_iters = (float(iters) if prev is None
                             else prev + 0.2 * (iters - prev))
 
+    @property
+    def pending(self) -> int:
+        """Requests accepted but not yet admitted into a slot (the fleet
+        scheduler's demand signal)."""
+        return len(self._pending)
+
+    def predicted_service_s(self, n_items: int = 1) -> float | None:
+        """Predicted seconds for one full generation once admitted:
+        iterations-per-request EWMA priced at the step EWMA (the engine's
+        counterpart of the batcher's per-bucket duration model). None
+        before any retirement."""
+        if not self._ewma_step_ms or not self._ewma_iters:
+            return None
+        return max(1, n_items) * self._ewma_iters * self._ewma_step_ms / 1e3
+
     def estimate_clear_s(self) -> float | None:
-        """Queue-clear estimate for 429 Retry-After hints: pending requests
+        """Queue-clear estimate (raw, unclamped — same split as the
+        batcher's: ``clamp_retry_after_s`` owns the 429 Retry-After hint):
+        pending requests
         times the observed iterations-per-request, priced at the step EWMA,
         amortized over the slot width. None before any retirement."""
         if not self._pending:

@@ -152,8 +152,12 @@ class ModelLifecycle:
             # runtime_compiles_total delta stays 0 across reload churn.
             if hasattr(self.runtime, "ensure_compiled"):
                 try:
+                    # The staged tree supplies the param shardings when the
+                    # live tree is absent (a cold-booted model's first
+                    # warm-up, tpuserve.scheduler); steady state this is
+                    # the same no-op it always was.
                     n_new = await loop.run_in_executor(
-                        None, self.runtime.ensure_compiled)
+                        None, partial(self.runtime.ensure_compiled, staged))
                     if n_new:
                         log.info("%s: compiled %d missing variant(s) at "
                                  "stage time", self.name, n_new)

@@ -188,6 +188,27 @@ RELOAD_STAGES = ("integrity", "nan_scan", "structure", "load",
 ROLLBACK_REASONS = ("manual", "post_publish_canary", "soak_breaker",
                     "soak_canary")
 
+# Priority classes (tpuserve.scheduler; the X-Priority request header and
+# the per-model `priority` default): the label on
+# queue_wait_ms{model=,priority=}. Under fleet overload, "batch" sheds
+# first; "interactive" is protected by the [scheduler] min_share floor.
+PRIORITIES = ("interactive", "batch")
+
+# Fleet-scheduler model states as gauge values (model_state{model=...}),
+# the warm/cold weight-paging state machine (tpuserve.scheduler): cold =
+# no device params resident (HBM free), warming = staging through the
+# lifecycle path, warm = serving.
+MODEL_STATES = {"cold": 0.0, "warming": 1.0, "warm": 2.0}
+
+# Reasons on sched_sheds_total{model=,reason=} (tpuserve.scheduler):
+# "deadline_unmeetable" — the stamped deadline provably cannot be met at
+# admission (fast 504, Clockwork P3); "priority_shed" — batch-class work
+# shed under fleet saturation; "share_exceeded" — an over-allowance model
+# shed while another model's interactive traffic was starved below
+# min_share; "model_warming" — shed during a cold model's warming window.
+SCHED_SHED_REASONS = ("deadline_unmeetable", "priority_shed",
+                      "share_exceeded", "model_warming")
+
 
 class Metrics:
     """Registry of all server metrics. One instance per server process."""
@@ -279,6 +300,36 @@ class Metrics:
         on one worker (tpuserve.workerproc.router feeds the least-loaded
         pick from it)."""
         return self.gauge(f"worker_inflight{{worker={worker}}}")
+
+    def queue_wait_histogram(self, model: str, priority: str) -> Histogram:
+        """queue_wait_ms{model=,priority=}: time a request spent queued
+        before its batch flushed (or its generation slot admitted), split
+        by priority class (tpuserve.scheduler). Batch-class p99 growing
+        while interactive stays flat is the priority arbitration working;
+        both growing is genuine undercapacity. Prebound at batcher/engine
+        start — never call per request."""
+        return self.histogram(
+            f"queue_wait_ms{{model={model},priority={priority}}}")
+
+    def sched_shed_counter(self, model: str, reason: str) -> Counter:
+        """sched_sheds_total{model=,reason=}: requests the fleet scheduler
+        refused at admission, by reason (one of SCHED_SHED_REASONS).
+        Prebound by the scheduler at registration — never call per
+        request."""
+        return self.counter(
+            f"sched_sheds_total{{model={model},reason={reason}}}")
+
+    def sched_device_seconds_counter(self, model: str) -> Counter:
+        """sched_device_seconds_total{model=}: cumulative device-section
+        seconds this model's dispatches consumed (fed by batch compute /
+        generation step timings) — the fleet scheduler's cross-model
+        device-time ledger in monotonic form."""
+        return self.counter(f"sched_device_seconds_total{{model={model}}}")
+
+    def set_model_state(self, model: str, state: str) -> None:
+        """model_state{model=}: the warm/cold paging state as a gauge
+        (MODEL_STATES: cold 0 / warming 1 / warm 2)."""
+        self.gauge(f"model_state{{model={model}}}").set(MODEL_STATES[state])
 
     def set_model_version(self, model: str, version: int) -> None:
         """model_version{model=}: the live weight-tree version number

@@ -447,7 +447,7 @@ class ModelRuntime:
             self.model.name, len(buckets), len(self.meshes), time.perf_counter() - t0,
         )
 
-    def ensure_compiled(self) -> int:
+    def ensure_compiled(self, params_per_mesh: "list[Any] | None" = None) -> int:
         """Compile any configured bucket missing from the variant registry;
         returns how many variants were newly compiled.
 
@@ -456,7 +456,14 @@ class ModelRuntime:
         first-compile: by the time a candidate tree runs, every variant it
         can reach is resident. In the common case (shapes unchanged across
         versions, which stage_params enforces) this is a cheap no-op whose
-        return value of 0 is itself the steady-state proof."""
+        return value of 0 is itself the steady-state proof.
+
+        ``params_per_mesh`` supplies the tree the compilation derives its
+        param shardings/structs from when the LIVE tree is absent — a
+        cold-booted model's first warm-up (tpuserve.scheduler) compiles
+        against the staged candidate before anything has published. Once
+        compiled, warm→cold→warm churn re-uses the variants: the counter
+        delta across re-warms of an already-compiled model is 0."""
         new = 0
         if not self.compile_forward:
             # Engine-backed runtime: the generative programs were all
@@ -466,7 +473,7 @@ class ModelRuntime:
             return new
         for b in self.model.buckets():
             if self.variant_key(tuple(b)) not in self.variants:
-                self._compile_bucket(tuple(b))
+                self._compile_bucket(tuple(b), params_per_mesh)
                 new += 1
         return new
 
@@ -485,11 +492,13 @@ class ModelRuntime:
             self.variants.items(),
             key=lambda kv: tuple(str(x) for x in kv[0].bucket))]
 
-    def _compile_bucket(self, bucket: tuple) -> None:
+    def _compile_bucket(self, bucket: tuple,
+                        params_per_mesh: "list[Any] | None" = None) -> None:
         t0 = time.perf_counter()
         exes = []
+        ppm = params_per_mesh if params_per_mesh else self.params_per_mesh
         for i, mesh in enumerate(self.meshes):
-            params = self.params_per_mesh[i]
+            params = ppm[i]
             batch_struct = self.model.input_signature(bucket)
             # batch_spec is either one P applied to every leaf, or a pytree of
             # P matching batch_struct's structure.
@@ -874,8 +883,10 @@ class ModelRuntime:
         finish on the version they captured, which is version-consistent
         per batch by construction."""
         with self._reload_lock:
-            self._prev_params = self.params_per_mesh
-            self._prev_version = self.version
+            # A cold-booted/demoted runtime has no live tree: retaining []
+            # would make rollback() "restore" an unservable empty state.
+            self._prev_params = self.params_per_mesh or None
+            self._prev_version = self.version if self.params_per_mesh else None
             self._version_seq += 1
             self.version = self._version_seq
             self.params_per_mesh = staged
@@ -901,6 +912,25 @@ class ModelRuntime:
             self._prev_version = None
             return {"model": self.model.name, "version": self.version,
                     "rolled_back_from": rolled_from}
+
+    def release_params(self) -> None:
+        """Demote to cold (tpuserve.scheduler weight paging): drop every
+        device-resident param tree — the live one AND the retained
+        last-known-good — so the device buffers free once in-flight batches
+        (which captured their own references at dispatch) complete. The
+        compiled variant registry stays resident: a later re-warm
+        (stage_params → publish) serves through the same executables with
+        zero recompiles."""
+        with self._reload_lock:
+            self.params_per_mesh = []
+            self._prev_params = None
+            self._prev_version = None
+
+    @property
+    def params_resident(self) -> bool:
+        """True while a live device param tree is resident (False = cold:
+        HBM for this model's weights is free)."""
+        return bool(self.params_per_mesh)
 
     def reload_params(self) -> dict:
         """Hot-swap weights from cfg.weights without recompiling.

@@ -1,0 +1,23 @@
+"""Fleet-level SLO scheduler (ISSUE 10; docs/ROBUSTNESS.md "Fleet
+isolation & SLO admission").
+
+One server fronts many models; without cross-model arbitration one hot
+model queue-starves the rest and every model's weights must fit in HBM at
+once. This package is the central scheduler between admission
+(server.handle_predict / the router tier) and the per-model
+batchers/engines:
+
+- :class:`FleetScheduler` — predictive admission (Clockwork, PAPERS.md
+  P3: shed work that provably cannot meet its deadline, in microseconds),
+  priority classes over a per-model device-seconds ledger (Clipper P1:
+  low-priority sheds first; interactive floors hold), and the warm/cold
+  weight-paging state machine (cold models boot without device params and
+  stage through the lifecycle path on demand).
+- :func:`run_fleet_drill` — the isolation drill behind
+  ``python -m tpuserve chaos --drill fleet``: poison one model at 100%
+  under multi-model load and measure that the victim's breaker opens
+  while every other model holds its SLO.
+"""
+
+from tpuserve.scheduler.fleet import FleetScheduler, Shed  # noqa: F401
+from tpuserve.scheduler.drill import run_fleet_drill  # noqa: F401

@@ -1,0 +1,109 @@
+"""Fleet isolation drill (``python -m tpuserve chaos --drill fleet``;
+Clipper's isolation story, PAPERS.md P1, measured).
+
+A fleet's availability property is per-model isolation: one misbehaving
+model must cost ITS OWN traffic, never the front door. The drill serves
+one real multi-model server (>= 3 models, fleet scheduler armed), drives
+a closed-loop load generator at EVERY model concurrently, poisons one
+victim with ``device_error`` at 100% probability (every dispatch below
+the batcher fails — retry, split, and breaker all see real failures),
+and measures:
+
+- **victim containment** — the victim's circuit breaker opens, so its
+  traffic degrades to fast 503s instead of slow 500s;
+- **survivor availability** — every OTHER model holds availability >=
+  the bound (default 99%) with its p99 within budget: the poisoned
+  model's failing dispatches never starve the survivors' batchers,
+  stage executors, or admission;
+- the summary's ``availability`` is the MINIMUM across survivors (the
+  number the chaos CLI gates), with per-model latency percentiles and
+  the scheduler/breaker/injector state attached for the script gates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from tpuserve.config import FaultRuleConfig, ServerConfig
+
+log = logging.getLogger("tpuserve.scheduler")
+
+
+async def run_fleet_drill(cfg: ServerConfig, victim: str | None = None,
+                          duration_s: float = 10.0, warmup_s: float = 1.0,
+                          concurrency: int = 8) -> dict:
+    """Serve ``cfg``'s models on an ephemeral port with the victim
+    poisoned, load every model concurrently, and report per-model
+    availability + breaker/scheduler state. The caller (CLI / script)
+    owns asserting the bounds."""
+    from aiohttp import web
+
+    from tpuserve.bench.loadgen import run_load, synthetic_image_npy
+    from tpuserve.server import ServerState, make_app
+
+    if len(cfg.models) < 3:
+        raise ValueError(
+            f"the fleet drill needs >= 3 models to prove isolation; "
+            f"config has {len(cfg.models)}")
+    victim = victim or cfg.models[0].name
+    if victim not in {m.name for m in cfg.models}:
+        raise ValueError(f"victim {victim!r} is not a configured model")
+
+    # Poison the victim: every dispatch below the batcher raises, so the
+    # whole recovery ladder (retry -> split -> breaker) runs against real
+    # failures. The drill proves the blast radius stops at the victim.
+    cfg.faults.enabled = True
+    cfg.faults.rules.append(FaultRuleConfig(
+        kind="device_error", model=victim, probability=1.0))
+    # The drill IS the scheduler's fleet mode; and every measured response
+    # must be a real execution — a cache would serve perfect answers on
+    # behalf of a poisoned model.
+    cfg.scheduler.enabled = True
+    cfg.cache.enabled = False
+
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    try:
+        port = runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        loads = await asyncio.gather(*(
+            run_load(f"{base}/v1/models/{m.name}:predict",
+                     synthetic_image_npy(edge=m.wire_size),
+                     "application/x-npy", duration_s, concurrency, warmup_s)
+            for m in cfg.models))
+        breakers = {n: br.describe() for n, br in state.breakers.items()}
+        sched = state.scheduler.stats() if state.scheduler else {}
+        faults = state.injector.snapshot() if state.injector else []
+    finally:
+        await runner.cleanup()
+
+    models: dict[str, dict] = {}
+    survivor_avail = []
+    for m, res in zip(cfg.models, loads):
+        total = res.n_ok + res.n_err
+        avail = round(res.n_ok / total, 5) if total else 0.0
+        row = res.summary()
+        row["availability"] = avail
+        row["role"] = "victim" if m.name == victim else "survivor"
+        models[m.name] = row
+        if m.name != victim:
+            survivor_avail.append(avail)
+    return {
+        "drill": "fleet",
+        "victim": victim,
+        "victim_breaker": breakers.get(victim, {}),
+        "victim_breaker_open": breakers.get(victim, {}).get("state")
+        in ("open", "half_open"),
+        # The chaos CLI gates this: the WORST survivor must hold the SLO.
+        "availability": min(survivor_avail) if survivor_avail else 0.0,
+        "models": models,
+        "breakers": breakers,
+        "scheduler": sched,
+        "faults": faults,
+    }

@@ -1,0 +1,421 @@
+"""FleetScheduler: cross-model SLO admission, priority arbitration, and
+warm/cold weight paging (ISSUE 10; docs/ROBUSTNESS.md).
+
+The scheduler owns three decisions, all made at admission time, all in
+microseconds, all BEFORE a request occupies a queue slot:
+
+1. **Predictive admission** (Clockwork, PAPERS.md P3). Each batcher/engine
+   already keeps per-bucket batch-duration EWMAs (PR 5); the scheduler
+   generalizes them into ``predict_completion_s(model)`` = raw queue-clear
+   estimate + service-time EWMA. A request whose stamped deadline leaves
+   less than that (plus ``headroom_ms`` grace) is shed with a fast 504
+   ``deadline_unmeetable`` + Retry-After — rejected in microseconds at the
+   front door instead of failing in seconds at the back of the queue.
+
+2. **Priority classes + device-time accounting** (Clipper, P1). Dispatch
+   timings feed a sliding-window per-model device-seconds ledger. When the
+   aggregate predicted queue-clear across the fleet exceeds
+   ``overload_clear_s`` the fleet is saturated: batch-class work sheds
+   first (503 ``priority_shed``), and the ``min_share`` floor guarantees
+   no model's interactive traffic starves — a model consuming more than
+   its allowance (1 - min_share x other demanding models) sheds
+   (``share_exceeded``) while any other model with queued work sits below
+   the floor.
+
+3. **Warm/cold weight paging**. A model declared ``cold_start`` boots with
+   zero device params resident; its first request (or an explicit
+   ``POST .../{name}:warm``) triggers a warm-up through the lifecycle
+   stage→publish path — integrity gates, variant compilation, staged
+   canary, atomic publish — so no request is ever answered by unstaged
+   weights, and requests during the warming window shed 503
+   ``model_warming`` + Retry-After (the breaker machinery's discipline
+   applied to the state path). ``idle_demote_s`` of quiet demotes the
+   model back to cold, releasing its device params while the compiled
+   variant registry stays resident — a re-warm recompiles nothing.
+
+All scheduler state is event-loop-only (admission, the ledger callbacks,
+and the sweep task all run on the server loop); there is deliberately no
+lock to witness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable
+
+from tpuserve.batcher import clamp_retry_after_s
+from tpuserve.config import SchedulerConfig
+from tpuserve.obs import PRIORITIES, SCHED_SHED_REASONS, Metrics
+
+log = logging.getLogger("tpuserve.scheduler")
+
+COLD, WARMING, WARM = "cold", "warming", "warm"
+
+
+@dataclass
+class Shed:
+    """One admission refusal: HTTP status, the reason label (one of
+    obs.SCHED_SHED_REASONS — also the ``reason`` key in the response
+    body, relayed by the router tier), a human message, and the
+    Retry-After hint in seconds (None = no hint)."""
+
+    status: int
+    reason: str
+    message: str
+    retry_after: int | None = None
+
+
+class _Entry:
+    """Per-model scheduler state."""
+
+    __slots__ = ("name", "batcher", "mcfg", "runtime", "warm_fn", "state",
+                 "ledger", "window_sum", "last_used", "last_warm_s",
+                 "next_warm_at", "warm_task", "shed_counters",
+                 "device_seconds_total")
+
+    def __init__(self, name: str, batcher: Any, mcfg: Any,
+                 runtime: Any | None,
+                 warm_fn: Callable[[], Awaitable[Any]] | None,
+                 metrics: Metrics) -> None:
+        self.name = name
+        self.batcher = batcher
+        self.mcfg = mcfg
+        self.runtime = runtime
+        self.warm_fn = warm_fn
+        self.state = WARM
+        # Sliding-window device-seconds ledger: (monotonic ts, seconds).
+        self.ledger: deque[tuple[float, float]] = deque()
+        self.window_sum = 0.0
+        self.last_used = time.monotonic()
+        self.last_warm_s: float | None = None
+        self.next_warm_at = 0.0  # failed-warm backoff (monotonic)
+        self.warm_task: asyncio.Task | None = None
+        self.shed_counters = {r: metrics.sched_shed_counter(name, r)
+                              for r in SCHED_SHED_REASONS}
+        self.device_seconds_total = metrics.sched_device_seconds_counter(name)
+
+
+class FleetScheduler:
+    """Cross-model admission arbiter over the per-model batchers/engines.
+
+    The server registers every model at start(); handle_predict consults
+    ``resolve_priority`` / ``check_admission`` / ``check_deadline`` before
+    a request reaches a batcher, and the batchers feed dispatch timings
+    back through the per-model ``device_time_cb`` hook."""
+
+    def __init__(self, cfg: SchedulerConfig, metrics: Metrics) -> None:
+        self.cfg = cfg
+        self.metrics = metrics
+        self._entries: dict[str, _Entry] = {}
+        self._sweep_task: asyncio.Task | None = None
+
+    # -- registration ---------------------------------------------------------
+    def register(self, name: str, batcher: Any, mcfg: Any,
+                 runtime: Any | None = None,
+                 warm_fn: Callable[[], Awaitable[Any]] | None = None,
+                 cold: bool = False) -> None:
+        """Register one served model. ``warm_fn`` is the coroutine that
+        stages weights to live (normally ``ModelLifecycle.reload``);
+        ``cold=True`` starts the model in the cold state (no device params
+        resident — ServerState.build skipped the load)."""
+        e = _Entry(name, batcher, mcfg, runtime, warm_fn, self.metrics)
+        if cold:
+            e.state = COLD
+        self._entries[name] = e
+        self.metrics.set_model_state(name, e.state)
+        # Ledger feed: the batcher/engine calls this per dispatch with the
+        # device-section seconds (event loop only, like all state here).
+        batcher.device_time_cb = self._make_recorder(e)
+
+    def _make_recorder(self, e: _Entry):
+        def record(seconds: float) -> None:
+            now = time.monotonic()
+            e.ledger.append((now, seconds))
+            e.window_sum += seconds
+            e.device_seconds_total.inc(seconds)
+            self._trim(e, now)
+        return record
+
+    # -- lifecycle ------------------------------------------------------------
+    async def start(self) -> None:
+        if self.cfg.idle_demote_s > 0 and self._sweep_task is None:
+            self._sweep_task = asyncio.get_running_loop().create_task(
+                self._sweep_loop())
+
+    async def stop(self) -> None:
+        tasks = [t for t in ([self._sweep_task]
+                             + [e.warm_task for e in self._entries.values()])
+                 if t is not None and not t.done()]
+        self._sweep_task = None
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: PERF203
+                pass
+
+    # -- prediction (Clockwork P3) --------------------------------------------
+    def predict_completion_s(self, model: str, n_items: int = 1) -> float | None:
+        """Predicted seconds until a request admitted NOW completes: the
+        raw (unclamped) queue-clear estimate plus the service-time EWMA of
+        the bucket covering it. None before any duration evidence exists
+        (admit optimistically — shedding needs proof)."""
+        e = self._entries[model]
+        clear = e.batcher.estimate_clear_s() or 0.0
+        svc = e.batcher.predicted_service_s(n_items)
+        if svc is None and clear <= 0.0:
+            return None
+        return clear + (svc or 0.0)
+
+    # -- priority / ledger ----------------------------------------------------
+    def resolve_priority(self, model: str, header: str | None) -> str:
+        """The request's priority class: the X-Priority header when
+        present (validated), else the model's configured default. Raises
+        ValueError (-> 400) on junk."""
+        if header is None or header == "":
+            e = self._entries.get(model)
+            return e.mcfg.priority if e is not None else "interactive"
+        value = header.strip().lower()
+        if value not in PRIORITIES:
+            raise ValueError(
+                f"X-Priority must be one of {list(PRIORITIES)}, got {header!r}")
+        return value
+
+    def _trim(self, e: _Entry, now: float) -> None:
+        horizon = now - self.cfg.window_s
+        while e.ledger and e.ledger[0][0] < horizon:
+            _, s = e.ledger.popleft()
+            e.window_sum -= s
+
+    def share(self, model: str) -> float:
+        """The model's fraction of all device-seconds recorded in the
+        sliding window (0.0 when the fleet is idle)."""
+        now = time.monotonic()
+        total = 0.0
+        for e in self._entries.values():
+            self._trim(e, now)
+            total += e.window_sum
+        if total <= 0.0:
+            return 0.0
+        return self._entries[model].window_sum / total
+
+    def saturated(self) -> bool:
+        """Aggregate demand exceeds capacity: the summed raw queue-clear
+        prediction across warm models exceeds ``overload_clear_s``."""
+        agg = sum((e.batcher.estimate_clear_s() or 0.0)
+                  for e in self._entries.values() if e.state == WARM)
+        return agg > self.cfg.overload_clear_s
+
+    # -- admission ------------------------------------------------------------
+    def _shed(self, e: _Entry, status: int, reason: str, message: str,
+              retry_after: int | None) -> Shed:
+        e.shed_counters[reason].inc()
+        return Shed(status, reason, message, retry_after)
+
+    def check_admission(self, model: str, priority: str) -> Shed | None:
+        """Pre-body admission: warm/cold state and priority arbitration.
+        Returns a Shed to answer immediately, or None to proceed. A cold
+        model's first request triggers its warm-up as a side effect."""
+        e = self._entries[model]
+        if e.state != WARM:
+            self._ensure_warming(e)
+            eta = max(1, math.ceil(e.last_warm_s
+                                   if e.last_warm_s
+                                   else self.cfg.warm_retry_after_s))
+            return self._shed(
+                e, 503, "model_warming",
+                f"model {model!r} is {e.state}; weights are being staged",
+                eta)
+        if not self.saturated():
+            return None
+        agg_hint = clamp_retry_after_s(sum(
+            (x.batcher.estimate_clear_s() or 0.0)
+            for x in self._entries.values())) or 1
+        if priority == "batch":
+            # Low-priority work sheds first under overload (Clipper P1).
+            return self._shed(
+                e, 503, "priority_shed",
+                "fleet saturated; batch-priority work shed first", agg_hint)
+        if self.cfg.min_share > 0:
+            others = [o for o in self._entries.values()
+                      if o is not e and o.state == WARM]
+            demanding = [o for o in others if o.batcher.pending > 0]
+            starved = [o for o in demanding
+                       if self.share(o.name) < self.cfg.min_share]
+            allowed = 1.0 - self.cfg.min_share * len(demanding)
+            if starved and self.share(model) > allowed:
+                # The floor has teeth: the hog yields device time until the
+                # starved model's interactive traffic catches up.
+                return self._shed(
+                    e, 503, "share_exceeded",
+                    f"model {model!r} exceeds its device-time allowance "
+                    f"({allowed:.2f}) while "
+                    f"{', '.join(o.name for o in starved)} is starved",
+                    agg_hint)
+        return None
+
+    def check_deadline(self, model: str,
+                       deadline_at: float | None) -> Shed | None:
+        """Post-stamping admission: shed when the deadline provably cannot
+        be met (fast 504 ``deadline_unmeetable`` — the Clockwork property:
+        reject in microseconds, don't fail in seconds)."""
+        if deadline_at is None:
+            return None
+        pred = self.predict_completion_s(model)
+        if pred is None:
+            return None
+        now = time.perf_counter()
+        remaining = deadline_at - now
+        if remaining + self.cfg.headroom_ms / 1e3 >= pred:
+            return None
+        e = self._entries[model]
+        hint = clamp_retry_after_s(e.batcher.estimate_clear_s()) \
+            or clamp_retry_after_s(pred) or 1
+        return self._shed(
+            e, 504, "deadline_unmeetable",
+            f"deadline_unmeetable: {remaining * 1e3:.0f} ms remaining but "
+            f"predicted completion is {pred * 1e3:.0f} ms", hint)
+
+    def touch(self, model: str) -> None:
+        """Record model activity (the idle-demotion clock)."""
+        self._entries[model].last_used = time.monotonic()
+
+    # -- warm/cold state machine ----------------------------------------------
+    def is_warm(self, model: str) -> bool:
+        e = self._entries.get(model)
+        return e is None or e.state == WARM
+
+    def state_of(self, model: str) -> str:
+        return self._entries[model].state
+
+    def _ensure_warming(self, e: _Entry) -> None:
+        """Kick the warm-up task if none is running (failed warms back off
+        ``warm_retry_after_s`` so a broken checkpoint can't hot-loop
+        expensive staging)."""
+        if e.warm_fn is None or e.state == WARM:
+            return
+        if e.warm_task is not None and not e.warm_task.done():
+            return
+        if time.monotonic() < e.next_warm_at:
+            return
+        e.warm_task = asyncio.get_running_loop().create_task(self._do_warm(e))
+
+    async def _do_warm(self, e: _Entry) -> dict:
+        self._set_state(e, WARMING)
+        t0 = time.perf_counter()
+        try:
+            info = await e.warm_fn()
+        except asyncio.CancelledError:
+            self._set_state(e, COLD)
+            raise
+        except Exception:
+            self._set_state(e, COLD)
+            e.next_warm_at = time.monotonic() + self.cfg.warm_retry_after_s
+            log.exception("%s: warm-up failed; model stays cold", e.name)
+            raise
+        e.last_warm_s = time.perf_counter() - t0
+        e.last_used = time.monotonic()
+        self._set_state(e, WARM)
+        log.info("%s: warmed in %.2fs (version %s)", e.name, e.last_warm_s,
+                 (info or {}).get("version"))
+        return {"model": e.name, "state": WARM,
+                "warm_ms": round(e.last_warm_s * 1e3, 1),
+                "version": (info or {}).get("version")}
+
+    async def warm(self, model: str) -> dict:
+        """Explicit warm-up (``POST .../{name}:warm``): joins the in-flight
+        warm task if one is running; returns once the model serves. The
+        shared task is shielded so one impatient client disconnecting
+        cannot cancel everyone's warm-up."""
+        e = self._entries[model]
+        if e.state == WARM:
+            return {"model": model, "state": WARM, "already_warm": True}
+        if e.warm_fn is None:
+            raise ValueError(f"model {model!r} has no warm path registered")
+        e.next_warm_at = 0.0  # explicit ask overrides the failure backoff
+        self._ensure_warming(e)
+        return await asyncio.shield(e.warm_task)
+
+    def demote(self, model: str) -> bool:
+        """Demote a warm cold_start model back to cold, releasing its
+        device params (in-flight batches finish on the references they
+        captured at dispatch). Returns True when a demotion happened."""
+        e = self._entries[model]
+        if e.state != WARM or not e.mcfg.cold_start or e.runtime is None:
+            return False
+        self._set_state(e, COLD)
+        e.runtime.release_params()
+        log.info("%s: idle-demoted to cold (device params released)", model)
+        return True
+
+    def _set_state(self, e: _Entry, state: str) -> None:
+        e.state = state
+        self.metrics.set_model_state(e.name, state)
+
+    async def _sweep_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.sweep_interval_s)
+            try:
+                self.sweep_idle()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # one bad sweep must not end demotion
+                log.exception("scheduler idle sweep failed")
+
+    def sweep_idle(self) -> int:
+        """Demote every warm cold_start model idle past ``idle_demote_s``
+        with nothing queued or in flight; returns demotions performed."""
+        if self.cfg.idle_demote_s <= 0:
+            return 0
+        now = time.monotonic()
+        demoted = 0
+        for e in self._entries.values():
+            if not e.mcfg.cold_start or e.state != WARM:
+                continue
+            if now - e.last_used < self.cfg.idle_demote_s:
+                continue
+            if e.batcher.pending > 0:
+                continue
+            if self.demote(e.name):
+                demoted += 1
+        return demoted
+
+    # -- introspection --------------------------------------------------------
+    def stats(self) -> dict:
+        """The /stats ``scheduler`` block: fleet saturation plus, per
+        model, the paging state, priority default, windowed device-time
+        share, and the live completion prediction."""
+        now = time.monotonic()
+        models: dict[str, dict] = {}
+        for name, e in self._entries.items():
+            self._trim(e, now)
+            pred = self.predict_completion_s(name)
+            models[name] = {
+                "state": e.state,
+                "priority": e.mcfg.priority,
+                "cold_start": e.mcfg.cold_start,
+                "share": round(self.share(name), 4),
+                "device_seconds_window": round(e.window_sum, 4),
+                "device_seconds_total": round(e.device_seconds_total.value, 4),
+                "predicted_completion_s": round(pred, 4)
+                if pred is not None else None,
+                "pending": e.batcher.pending,
+                "last_warm_ms": round(e.last_warm_s * 1e3, 1)
+                if e.last_warm_s else None,
+                "sheds": {r: c.value for r, c in e.shed_counters.items()
+                          if c.value},
+            }
+        return {
+            "saturated": self.saturated(),
+            "window_s": self.cfg.window_s,
+            "overload_clear_s": self.cfg.overload_clear_s,
+            "min_share": self.cfg.min_share,
+            "idle_demote_s": self.cfg.idle_demote_s,
+            "models": models,
+        }

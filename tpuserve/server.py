@@ -48,7 +48,8 @@ import jax
 
 from tpuserve import models as modelzoo
 from tpuserve.analysis import witness
-from tpuserve.batcher import DeadlineExceeded, ModelBatcher, QueueFull
+from tpuserve.batcher import (DeadlineExceeded, ModelBatcher, QueueFull,
+                              clamp_retry_after_s)
 from tpuserve.bench.roofline import compute_split, phase_p50
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig
@@ -56,8 +57,9 @@ from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
 from tpuserve.genserve import GenEngine
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
-from tpuserve.obs import Metrics
+from tpuserve.obs import PRIORITIES, Metrics
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
+from tpuserve.scheduler import FleetScheduler
 
 log = logging.getLogger("tpuserve.server")
 
@@ -131,6 +133,12 @@ class ServerState:
         # Prebound per-model hot-path handles (metrics + config), built at
         # start() so handle_predict does zero registry lookups per request.
         self.handles: dict[str, ModelHandles] = {}
+        # Fleet-level SLO scheduler (tpuserve.scheduler): cross-model
+        # admission, priority arbitration, warm/cold weight paging. None
+        # unless [scheduler] enabled — the per-model batchers then stay
+        # fully independent, exactly as before.
+        self.scheduler = (FleetScheduler(cfg.scheduler, self.metrics)
+                          if cfg.scheduler.enabled else None)
         self.canary_ok: dict[str, bool] = {}
         self._canary_task: asyncio.Task | None = None
         # Next periodic-canary fire time (time.monotonic clock): the live
@@ -173,7 +181,26 @@ class ServerState:
             for mcfg in self.cfg.models:
                 t0 = time.perf_counter()
                 model = modelzoo.build(mcfg)
-                if mcfg.session_mode == "recycle":
+                if mcfg.cold_start and self.scheduler is None:
+                    log.warning("model %s: cold_start ignored — [scheduler] "
+                                "is not enabled", mcfg.name)
+                if mcfg.cold_start and self.scheduler is not None:
+                    if self.cfg.genserve.enabled \
+                            and getattr(model, "generative", False):
+                        raise ValueError(
+                            f"model {mcfg.name}: cold_start does not compose "
+                            "with the generation engine yet (its programs "
+                            "compile against the live param structure)")
+                    # Cold boot (weight paging, docs/ROBUSTNESS.md "Fleet
+                    # isolation & SLO admission"): meshes are planned but NO
+                    # params are loaded and NO variants compiled — zero HBM
+                    # resident. The first request (or :warm) stages weights
+                    # through the lifecycle path; the scheduler sheds with
+                    # 503 + Retry-After until the publish lands.
+                    rt = ModelRuntime(model, metrics=self.metrics,
+                                      parallel=self.cfg.parallel)
+                    rt.injector = self.injector
+                elif mcfg.session_mode == "recycle":
                     # Deferred-readback worker pool (tpuserve.deferred): this
                     # process never touches the accelerator; forked workers
                     # own one PJRT session each.
@@ -280,6 +307,19 @@ class ServerState:
                     injector=self.injector,
                     staged_canary_fn=eng.staged_canary_sync
                     if eng is not None else None)
+            if self.scheduler is not None:
+                # Fleet registration: the scheduler reads each batcher's
+                # demand (pending, raw clear estimate, duration EWMAs) and
+                # feeds its device-seconds ledger from dispatch timings;
+                # cold models warm through the lifecycle's staged path so
+                # no request is ever answered by unvalidated weights.
+                lc = self.lifecycles.get(name)
+                self.scheduler.register(
+                    name, batcher=b, mcfg=model.cfg, runtime=rt,
+                    warm_fn=lc.reload if lc is not None else None,
+                    cold=bool(model.cfg.cold_start))
+        if self.scheduler is not None:
+            await self.scheduler.start()
         if self.cfg.startup_canary:
             await self.run_canaries()
         if self.cfg.canary_interval_s > 0:
@@ -320,6 +360,11 @@ class ServerState:
         """Tiny end-to-end inference for one model; feeds /healthz and
         half-opens/closes the circuit breaker (canaries ride the batcher
         regardless of breaker state — they ARE the recovery probe)."""
+        if self.scheduler is not None and not self.scheduler.is_warm(name):
+            # Cold/warming model (weight paging): there are no live params
+            # to probe, and the staged canary inside the warm-up path owns
+            # candidate validation. Never-measured reads as healthy.
+            return self.canary_ok.get(name, True)
         model = self.models[name]
         br = self.breakers.get(name)
         try:
@@ -371,6 +416,10 @@ class ServerState:
         worker nobody would ever use)."""
         await self.watchdog.stop()
         await self._stop_canary_loop()
+        if self.scheduler is not None:
+            # Same discipline: the idle-demotion sweep (and any in-flight
+            # warm-up) must not mutate model state under the drain.
+            await self.scheduler.stop()
         self.begin_drain()
         # Early-retire deferred epochs so pending futures resolve in
         # readback time instead of at the epoch deadline.
@@ -444,13 +493,14 @@ class ServerState:
     def queue_retry_after(self, name: str) -> int:
         """Retry-After seconds for queue-full 429s, derived from live state:
         the batcher's estimated queue-clear time at the observed serving
-        rate (per-bucket duration EWMAs), clamped to [1, 30] s. Falls back
+        rate (per-bucket duration EWMAs), clamped to [1, 30] s by
+        ``batcher.clamp_retry_after_s`` (the estimate itself stays raw for
+        the fleet scheduler's admission math). Falls back
         to the configured constant before any batch has completed."""
         b = self.batchers.get(name)
-        est = b.estimate_clear_s() if b is not None else None
-        if est is None:
-            return self.shed_retry_after()
-        return max(1, min(30, math.ceil(est)))
+        hint = clamp_retry_after_s(b.estimate_clear_s()
+                                   if b is not None else None)
+        return hint if hint is not None else self.shed_retry_after()
 
     def breaker_retry_after(self, name: str) -> int:
         """Retry-After seconds for breaker 503s, derived from live state:
@@ -478,6 +528,8 @@ class ServerState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
+        if self.scheduler is not None:
+            await self.scheduler.stop()
         for lc in self.lifecycles.values():
             lc.close()  # stop soak monitors
         await self._stop_canary_loop()
@@ -515,6 +567,28 @@ async def handle_predict(request: web.Request) -> web.Response:
         breaker.on_shed()
         return _err(503, f"circuit open for model {name!r}; recovery probe "
                          "in progress", retry_after=state.breaker_retry_after(name))
+    # Fleet scheduler admission, part 1 (pre-body; tpuserve.scheduler):
+    # warm/cold state and priority arbitration need only headers, so a
+    # cold model or shed batch-class request answers in microseconds. The
+    # deadline check runs after the deadline is stamped, below.
+    raw_priority = request.headers.get("X-Priority")
+    priority: str | None = None
+    if state.scheduler is not None:
+        try:
+            priority = state.scheduler.resolve_priority(name, raw_priority)
+        except ValueError as e:
+            return _err(400, str(e))
+        shed = state.scheduler.check_admission(name, priority)
+        if shed is not None:
+            return _err(shed.status, shed.message,
+                        retry_after=shed.retry_after, reason=shed.reason)
+        state.scheduler.touch(name)
+    elif raw_priority:
+        # No scheduler = no arbitration, but the class still labels the
+        # queue-wait split (header -> batcher); junk degrades to the
+        # model default rather than 400ing an unscheduled server.
+        value = raw_priority.strip().lower()
+        priority = value if value in PRIORITIES else None
     h = state.handles[name]
     mcfg = h.mcfg
     h.requests.inc()
@@ -554,6 +628,16 @@ async def handle_predict(request: web.Request) -> web.Response:
     timeout_s = (timeout_ms if timeout_ms is not None
                  else mcfg.request_timeout_ms) / 1e3
     deadline_at = t_start + timeout_s
+
+    # Fleet scheduler admission, part 2 (Clockwork P3): a deadline that
+    # provably cannot be met — predicted queue-clear + service time exceed
+    # the remaining budget — sheds with a fast 504 BEFORE decode or
+    # enqueue, instead of dying at the back of the queue.
+    if state.scheduler is not None:
+        shed = state.scheduler.check_deadline(name, deadline_at)
+        if shed is not None:
+            return _err(shed.status, shed.message,
+                        retry_after=shed.retry_after, reason=shed.reason)
 
     try:
         if state.injector is not None:
@@ -595,10 +679,11 @@ async def handle_predict(request: web.Request) -> web.Response:
                 fut = cache.submit_through(
                     key, lambda it=item: batcher.submit(
                         it, group=model.group_key(it),
-                        deadline_at=deadline_at))
+                        deadline_at=deadline_at, priority=priority))
             else:
                 fut = batcher.submit(item, group=model.group_key(item),
-                                     deadline_at=deadline_at)
+                                     deadline_at=deadline_at,
+                                     priority=priority)
             futs.append(fut)
             slots.append(i)
     except QueueFull:
@@ -727,6 +812,11 @@ async def handle_stats(request: web.Request) -> web.Response:
     if state.engines:
         out["genserve"] = {n: e.pipeline_stats()
                            for n, e in state.engines.items()}
+    # Fleet scheduler (docs/ROBUSTNESS.md "Fleet isolation & SLO
+    # admission"): saturation, per-model paging state, device-time shares,
+    # live completion predictions, and shed accounting.
+    if state.scheduler is not None:
+        out["scheduler"] = state.scheduler.stats()
     # Demand-shaping layer: per-model result-cache occupancy and the
     # hit/miss/coalesced/stale accounting (docs/PERFORMANCE.md).
     if state.caches:
@@ -826,15 +916,43 @@ async def handle_versions(request: web.Request) -> web.Response:
     return web.json_response(lc.describe())
 
 
+async def handle_warm(request: web.Request) -> web.Response:
+    """POST /admin/models/{name}:warm — stage a cold model's weights to
+    live through the lifecycle path (integrity gates, variant compile,
+    staged canary, atomic publish) and return once it serves. Idempotent
+    on a warm model; joins any warm-up already in flight. 409 when the
+    fleet scheduler is not enabled."""
+    state: ServerState = request.app[STATE_KEY]
+    name = request.match_info["name"]
+    if name not in state.runtimes:
+        return _err(404, f"unknown model {name!r}")
+    if state.scheduler is None:
+        return _err(409, "the fleet scheduler ([scheduler] enabled) owns "
+                         "warm/cold states; it is not enabled")
+    try:
+        info = await state.scheduler.warm(name)
+    except ValueError as e:
+        return _err(409, str(e))
+    except Exception as e:  # noqa: BLE001 — a failed warm keeps it cold
+        return _err(500, f"warm-up failed (model stays cold): {e}")
+    return web.json_response(info)
+
+
 async def handle_index(request: web.Request) -> web.Response:
     return web.Response(text=_INDEX_HTML, content_type="text/html")
 
 
 def _err(status: int, message: str,
-         retry_after: int | None = None) -> web.Response:
+         retry_after: int | None = None,
+         reason: str | None = None) -> web.Response:
     headers = {"Retry-After": str(retry_after)} if retry_after else None
-    return web.json_response({"error": message}, status=status,
-                             headers=headers)
+    body = {"error": message}
+    if reason is not None:
+        # Machine-readable shed reason (obs.SCHED_SHED_REASONS): the
+        # router tier relays it so its own breaker 503s can carry the
+        # fleet's live shed cause.
+        body["reason"] = reason
+    return web.json_response(body, status=status, headers=headers)
 
 
 def _requested_timeout_ms(request: web.Request, body: bytes,
@@ -874,6 +992,7 @@ def make_app(state: ServerState) -> web.Application:
     app.router.add_get("/v1/models", handle_models)
     app.router.add_post("/admin/models/{name}:reload", handle_reload)
     app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
+    app.router.add_post("/admin/models/{name}:warm", handle_warm)
     app.router.add_get("/admin/models/{name}/versions", handle_versions)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import json
 import logging
 import math
 import signal
@@ -143,6 +144,11 @@ class RouterState:
         # of PR 5's version binding — a fleet-wide publish atomically
         # invalidates all older entries).
         self.generations: dict[str, int] = {}
+        # Last machine-readable shed reason each model's workers answered
+        # (the `reason` key on scheduler sheds, obs.SCHED_SHED_REASONS):
+        # surfaced on this router's own breaker 503s so a client shed at
+        # the front door still learns WHY the fleet is refusing work.
+        self.last_shed_reason: dict[str, str] = {}
         # Next allowed breaker probe per model (time.monotonic): while a
         # breaker is open, one request per breaker_retry_after_s is let
         # through as the recovery probe; everyone else sheds with the
@@ -214,17 +220,21 @@ class RouterState:
 
     # -- relay ---------------------------------------------------------------
     async def _attempt(self, w: WorkerHandle, name: str, verb: str,
-                       body: bytes, ctype: str,
-                       deadline_at: float) -> _Answer:
+                       body: bytes, ctype: str, deadline_at: float,
+                       priority: str | None = None) -> _Answer:
         """One complete request/response against one worker. The body is
         fully read before returning, so a relayed response is never torn:
         a worker dying mid-body surfaces as a transport error (and a
-        retry), not a truncated 200."""
+        retry), not a truncated 200. ``priority`` relays the client's
+        X-Priority so the worker's fleet scheduler arbitrates with the
+        class the client asked for (header -> worker -> batcher)."""
         remaining = deadline_at - time.perf_counter()
         timeout = aiohttp.ClientTimeout(
             total=max(0.001, remaining + _DEADLINE_GRACE_S),
             connect=self.rcfg.connect_timeout_ms / 1e3)
         headers = {"X-Timeout-Ms": f"{max(1.0, remaining * 1e3):.0f}"}
+        if priority:
+            headers["X-Priority"] = priority
         if ctype:
             headers["Content-Type"] = ctype
         self.supervisor.track_inflight(w, +1)
@@ -239,7 +249,8 @@ class RouterState:
             self.supervisor.track_inflight(w, -1)
 
     async def _relay(self, name: str, verb: str, body: bytes, ctype: str,
-                     deadline_at: float) -> _Answer:
+                     deadline_at: float,
+                     priority: str | None = None) -> _Answer:
         """Dispatch to the least-loaded healthy worker with retry + hedging
         under the absolute deadline. Returns the first definitive answer;
         raises NoHealthyWorker / RelayDeadline / UpstreamFailed."""
@@ -266,7 +277,8 @@ class RouterState:
                 return False
             tried.add(w.wid)
             t = loop.create_task(
-                self._attempt(w, name, verb, body, ctype, deadline_at))
+                self._attempt(w, name, verb, body, ctype, deadline_at,
+                              priority))
             tasks[t] = w
             return True
 
@@ -338,15 +350,30 @@ class RouterState:
                 t.cancel()
 
     async def relay_cacheable(self, name: str, verb: str, body: bytes,
-                              ctype: str, deadline_at: float) -> tuple:
+                              ctype: str, deadline_at: float,
+                              priority: str | None = None) -> tuple:
         """Cache-value form of _relay: returns ``(content_type, body)`` for
         a 200 (what the single-flight leader populates), raises
         _RelayedError for any other definitive answer (fans out to
         coalesced waiters, populates nothing)."""
-        ans = await self._relay(name, verb, body, ctype, deadline_at)
+        ans = await self._relay(name, verb, body, ctype, deadline_at,
+                                priority)
         if ans.status == 200:
             return (ans.content_type, ans.body)
         raise _RelayedError(ans)
+
+    def note_shed_reason(self, name: str, ans: _Answer) -> None:
+        """Remember the machine-readable shed reason a worker answered
+        (503/504 JSON with a `reason` key — the fleet scheduler's sheds),
+        so this router's own breaker 503s can carry the live cause."""
+        if ans.status not in (503, 504) or not ans.body:
+            return
+        try:
+            reason = json.loads(ans.body).get("reason")
+        except ValueError:
+            return
+        if isinstance(reason, str):
+            self.last_shed_reason[name] = reason
 
     # -- admin fan-out -------------------------------------------------------
     def live_workers(self) -> list[WorkerHandle]:
@@ -477,9 +504,13 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
         probe_at = state._probe_at.get(name, 0.0)
         if now < probe_at:
             breaker.on_shed()
+            # The live shed reason the workers last answered (the fleet
+            # scheduler's machine-readable cause) rides on the breaker
+            # 503, so a front-door shed still says WHY the model refuses.
             return _err(503, f"circuit open for model {name!r}; recovery "
                              "probe in progress",
-                        retry_after=max(1, math.ceil(probe_at - now)))
+                        retry_after=max(1, math.ceil(probe_at - now)),
+                        reason=state.last_shed_reason.get(name))
         # This request IS the recovery probe: open -> half_open, let it
         # through; its outcome closes or re-opens the breaker.
         breaker.probe()
@@ -489,6 +520,12 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
                     retry_after=state.no_worker_retry_after())
     h.requests.inc()
     t_start = time.perf_counter()
+
+    # Priority rides the wire verbatim (header -> worker -> batcher): the
+    # router validates nothing here — the worker's scheduler owns the
+    # class vocabulary and 400s junk — and the cache key below NEVER sees
+    # it (same bytes must hit the same entry regardless of priority).
+    priority = request.headers.get("X-Priority")
 
     body = await request.read()
     ctype = request.content_type or ""
@@ -502,7 +539,8 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
 
     state._inflight += 1
     try:
-        ans = await _dispatch(state, name, verb, body, ctype, deadline_at)
+        ans = await _dispatch(state, name, verb, body, ctype, deadline_at,
+                              priority)
     except NoHealthyWorker as e:
         breaker.record_failure()
         return _err(503, "no healthy worker; capacity respawning",
@@ -522,21 +560,25 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
         breaker.record_success()
     elif ans.status >= 500:
         breaker.record_failure()
+    state.note_shed_reason(name, ans)
     h.latency.observe((time.perf_counter() - t_start) * 1e3)
     return ans.to_response()
 
 
 async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
-                    ctype: str, deadline_at: float) -> _Answer:
+                    ctype: str, deadline_at: float,
+                    priority: str | None = None) -> _Answer:
     """Cache/single-flight front of the relay (router-owned PR-5 layer).
 
     The cache key is content-addressed at the WIRE level — the router has
     no models to decode with — so byte-identical uploads hit, and the
     per-model config generation in every key makes a fleet reload an
-    atomic invalidation."""
+    atomic invalidation. Priority deliberately stays OUT of the key: it
+    schedules the work, it does not change the answer."""
     cache = state.caches.get(name)
     if cache is None:
-        return await state._relay(name, verb, body, ctype, deadline_at)
+        return await state._relay(name, verb, body, ctype, deadline_at,
+                                  priority)
     key = cache.key_for((verb, ctype, body))
     entry = cache.get(key)
     if entry is not None:
@@ -545,7 +587,8 @@ async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
     loop = asyncio.get_running_loop()
     fut = cache.submit_through(
         key, lambda: loop.create_task(
-            state.relay_cacheable(name, verb, body, ctype, deadline_at)))
+            state.relay_cacheable(name, verb, body, ctype, deadline_at,
+                                  priority)))
     # A coalesced waiter still honors ITS deadline: cancelling the waiter
     # never cancels the leader's flight (ModelCache contract).
     remaining = deadline_at - time.perf_counter()
