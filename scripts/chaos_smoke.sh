@@ -35,6 +35,9 @@ scripts/roofline_smoke.sh
 echo "== genserve smoke (mixed-length load, early exits + fold-ins, compile delta 0) =="
 scripts/genserve_smoke.sh
 
+echo "== ingest smoke (framed wire, 3 accept loops balanced, compile delta 0) =="
+scripts/ingest_smoke.sh
+
 echo "== multichip smoke (8 replicas all serving / sharded mesh / reload mid-load) =="
 scripts/multichip_smoke.sh
 

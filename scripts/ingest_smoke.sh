@@ -1,0 +1,138 @@
+#!/usr/bin/env bash
+# Ingest smoke (ISSUE 11): a REAL `tpuserve serve` process with
+# ingest_loops = 3 (one main + two SO_REUSEPORT ingest event-loop threads)
+# driven by the framed-wire loadgen (`tpuserve bench --wire frame`), gating:
+#   1. zero request errors AND zero unexpected malformed-frame counts (a
+#      deliberate garbage frame answers a machine-readable 400, never 500,
+#      with frame_errors_total ticking exactly once);
+#   2. EVERY accept loop serving a nonzero request count
+#      (ingest_requests_total{loop=} balance — a silent loop is a broken
+#      listener);
+#   3. zero assembly-arena overflow (the zero-copy frame views land in
+#      pooled arena buffers, not one-shot allocations);
+#   4. runtime_compiles_total delta exactly 0 across the loaded window
+#      (the framed multi-item path introduces no new specializations).
+# Witnessed (TPUSERVE_LOCK_WITNESS=1): the ingest threads + main-loop hop
+# double as a race-detection pass. See docs/PERFORMANCE.md "The ingest
+# fast path".
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export TPUSERVE_LOCK_WITNESS=1
+
+PORT=18461
+N_LOOPS=3
+TMPD="$(mktemp -d /tmp/ingest_smoke_XXXX)"
+CFG="$TMPD/cfg.toml"
+cat > "$CFG" <<EOF
+host = "127.0.0.1"
+port = $PORT
+ingest_loops = $N_LOOPS
+decode_threads = 2
+startup_canary = false
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+EOF
+
+python -m tpuserve serve --config "$CFG" &
+SERVER_PID=$!
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; rm -rf "$TMPD"' EXIT
+
+for _ in $(seq 1 60); do
+  if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then break; fi
+  sleep 0.5
+done
+curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null
+
+# Pre-load scrape: the compile-delta window opens AFTER startup compiles.
+curl -fsS "http://127.0.0.1:$PORT/metrics" > "$TMPD/metrics0.txt"
+
+# Framed-wire closed loop: 2 items per POST, 8 distinct bodies, toy edge 8.
+python -m tpuserve bench --url "http://127.0.0.1:$PORT" \
+  --model toy --verb classify --duration 4 --warmup 1 --concurrency 16 \
+  --wire frame --frame-kind rgb8 --edge 8 --batch 2 --distinct 8 \
+  > "$TMPD/load.json"
+echo "load: $(cat "$TMPD/load.json")"
+
+# One deliberately malformed frame: machine-readable 400, never 500.
+BAD_STATUS=$(curl -s -o "$TMPD/bad.json" -w '%{http_code}' \
+  -X POST "http://127.0.0.1:$PORT/v1/models/toy:classify" \
+  -H "Content-Type: application/x-tpuserve-frame" --data-binary garbage)
+echo "malformed frame -> $BAD_STATUS: $(cat "$TMPD/bad.json")"
+
+curl -fsS "http://127.0.0.1:$PORT/metrics" > "$TMPD/metrics1.txt"
+curl -fsS "http://127.0.0.1:$PORT/stats" > "$TMPD/stats.json"
+
+python - "$TMPD" "$BAD_STATUS" "$N_LOOPS" <<'EOF'
+import json
+import sys
+
+tmpd, bad_status, n_loops = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+
+def scrape(path):
+    out = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("#") or " " not in line:
+                continue
+            k, v = line.rsplit(" ", 1)
+            try:
+                out[k] = float(v)
+            except ValueError:
+                pass
+    return out
+
+
+m0 = scrape(f"{tmpd}/metrics0.txt")
+m1 = scrape(f"{tmpd}/metrics1.txt")
+with open(f"{tmpd}/load.json", encoding="utf-8") as f:
+    load = json.load(f)
+with open(f"{tmpd}/stats.json", encoding="utf-8") as f:
+    stats = json.load(f)
+
+# 1. zero errors on the framed run; the one injected garbage frame 400s.
+assert load["n_ok"] > 0 and load["n_err"] == 0, load
+assert load.get("items_per_request") == 2, load
+assert bad_status == 400, f"malformed frame answered {bad_status}, want 400"
+fe = m1.get('frame_errors_total{model="toy"}', 0)
+assert fe == 1, f"frame_errors_total={fe}, want exactly the 1 injected"
+
+# 2. every accept loop served requests (and bytes) — balance, not one hot loop.
+per_loop = [m1.get(f'ingest_requests_total{{loop="{i}"}}', 0.0)
+            for i in range(n_loops)]
+assert all(v > 0 for v in per_loop), f"silent accept loop(s): {per_loop}"
+ing = stats["ingest"]["loops"]
+assert set(ing) == {str(i) for i in range(n_loops)}, ing
+assert all(ing[str(i)]["bytes"] > 0 for i in range(n_loops)), ing
+
+# 3. zero arena overflow: frame views assembled into pooled buffers.
+overflow = m1.get('arena_overflow_total{model="toy"}', 0.0)
+assert overflow == 0, f"arena overflow under framed load: {overflow}"
+arena = stats["pipeline"]["models"]["toy"]["arena"]
+assert arena is not None and arena["overflow_total"] == 0, arena
+
+# 4. compile delta 0 across the loaded window: startup compiled everything.
+key = 'runtime_compiles_total{model="toy"}'
+assert m0.get(key, 0) > 0, "no compiles recorded at startup?"
+delta = m1.get(key, 0) - m0.get(key, 0)
+assert delta == 0, f"framed load recompiled: delta={delta}"
+
+print(f"ingest smoke OK: {load['throughput_per_s']:.1f} items/s over "
+      f"{n_loops} accept loops, per-loop requests {per_loop}, "
+      "1 garbage frame -> 400, arena overflow 0, compile delta 0")
+EOF
+
+kill -TERM $SERVER_PID
+wait $SERVER_PID 2>/dev/null || true
+trap 'rm -rf "$TMPD"' EXIT
+echo "ingest smoke OK"

@@ -192,6 +192,7 @@ class ModelBatcher:
         self._idle_event: asyncio.Event | None = None
         self._pending = 0
         self._running = False
+        self._loop: asyncio.AbstractEventLoop | None = None
         # Arena assembly requires assemble_into to produce exactly what
         # assemble would: provable only when assemble is the base
         # implementation, or the family overrode assemble_into alongside its
@@ -212,6 +213,9 @@ class ModelBatcher:
     # -- lifecycle ----------------------------------------------------------
     async def start(self) -> None:
         self._running = True
+        # The loop that owns every queue/future/counter below; captured so
+        # submit_threadsafe (the parallel-ingest entry) can hop onto it.
+        self._loop = asyncio.get_running_loop()
         pcfg = self.pipeline_cfg
         if self.deferred:
             # Deferred mode: enqueue's shm-slot wait is the device
@@ -229,6 +233,20 @@ class ModelBatcher:
                 # dispatch-to-ready only (roofline attribution).
                 self.runtime.h2d_sync = pcfg.h2d_sync
             self.depth = max(1, pcfg.depth or self.cfg.max_inflight)
+            if n_rep == 1 and getattr(self.runtime, "n_chips", 1) > 1:
+                import jax
+
+                if jax.default_backend() == "cpu":
+                    # Forced-host-device meshes (CPU CI/smokes/bench): the
+                    # fake devices share the host's cores, and CONCURRENT
+                    # multi-device program dispatches spin-wait against
+                    # each other — observed wedging every request past a
+                    # 60 s deadline at depth 4 (ISSUE 11). Serialize the
+                    # device section; depth > 1 buys nothing on a shared
+                    # core anyway. Real accelerator backends keep the
+                    # configured depth (per-device execution streams
+                    # serialize safely there).
+                    self.depth = 1
             self._staging = [SlotPool(self.depth) for _ in range(n_rep)]
             # Replica-aware admission: depth-k batches per DEVICE section
             # plus the assembly ramp — with 8 replicas the pipeline admits
@@ -312,6 +330,48 @@ class ModelBatcher:
         self._idle_event.clear()
         self._g_queue_depth.set(self._pending)
         return fut
+
+    def submit_threadsafe(self, item: Any, group: Hashable = None,
+                          deadline_at: float | None = None,
+                          priority: str | None = None) -> cf.Future:
+        """Loop-safe submit for callers OFF the batcher's event loop — the
+        parallel ingest loops (ISSUE 11; ``[server] ingest_loops``) and any
+        embedding thread. Schedules the real ``submit`` on the owning loop
+        (captured at ``start``) and returns a ``concurrent.futures.Future``
+        of the result; submit-time errors (QueueFull, RuntimeError) arrive
+        through the returned future instead of raising here. Cancelling the
+        returned future does NOT cancel the queued request (cancel
+        propagation across loops would race the flush; the request's own
+        deadline bounds it instead). On the owning loop, call ``submit``
+        directly — the hop would deadlock a caller that blocks on the
+        result."""
+        loop = self._loop
+        if not self._running or loop is None:
+            raise RuntimeError(f"batcher for {self.model.name} not started")
+        out: cf.Future = cf.Future()
+
+        def _do() -> None:
+            try:
+                fut = self.submit(item, group=group, deadline_at=deadline_at,
+                                  priority=priority)
+            except Exception as e:  # QueueFull / stopped: through the future
+                out.set_exception(e)
+                return
+
+            def _done(f: asyncio.Future) -> None:
+                if out.cancelled():
+                    return
+                if f.cancelled():
+                    out.cancel()
+                elif f.exception() is not None:
+                    out.set_exception(f.exception())
+                else:
+                    out.set_result(f.result())
+
+            fut.add_done_callback(_done)
+
+        loop.call_soon_threadsafe(_do)
+        return out
 
     def revive_group_loops(self) -> int:
         """Watchdog hook: restart group-accumulation tasks that died.

@@ -112,16 +112,46 @@ def synthetic_image_npy_batch(edge: int = 256, n: int = 8, seed: int = 0) -> byt
 
 
 def synthetic_pool(kind: str, n: int, edge: int = 256,
-                   batch: int = 0) -> list[bytes]:
-    """``n`` distinct synthetic payloads (seeds 0..n-1) for miss-only
-    workloads: every body decodes to different pixels, so every request is
-    a new cache key. ``kind`` is "jpeg" or "npy"; ``batch > 1`` builds
-    (batch, edge, edge, 3) npy client batches instead."""
+                   batch: int = 0, seed_base: int = 0) -> list[bytes]:
+    """``n`` distinct synthetic payloads (seeds seed_base..seed_base+n-1)
+    for miss-only workloads: every body decodes to different pixels, so
+    every request is a new cache key. ``kind`` is "jpeg" or "npy";
+    ``batch > 1`` builds (batch, edge, edge, 3) npy client batches
+    instead. ``seed_base`` gives multi-process load workers disjoint pools
+    — two workers cycling the SAME pool would coalesce in the server's
+    single-flight layer and share batch slots, inflating a miss-only
+    measurement (ISSUE 11 satellite)."""
     if batch > 1:
-        return [synthetic_image_npy_batch(edge, batch, seed=i)
+        return [synthetic_image_npy_batch(edge, batch, seed=seed_base + i)
                 for i in range(n)]
     gen = synthetic_image_jpeg if kind == "jpeg" else synthetic_image_npy
-    return [gen(edge, seed=i) for i in range(n)]
+    return [gen(edge, seed=seed_base + i) for i in range(n)]
+
+
+def synthetic_frame(edge: int = 256, n_items: int = 8, kind: str = "yuv420",
+                    seed: int = 0) -> bytes:
+    """One ``application/x-tpuserve-frame`` body of ``n_items`` distinct
+    random images (tpuserve.frame): the framed-wire client batch. yuv420
+    frames carry exactly the planes ``rgb_to_yuv420`` would produce from
+    the equivalent npy body, so framed and npy loads are answer-identical
+    (tests/test_frame.py pins it byte-for-byte)."""
+    from tpuserve import frame, preproc
+
+    rng = np.random.default_rng(seed)
+    items = []
+    for _ in range(n_items):
+        rgb = rng.integers(0, 255, (edge, edge, 3), dtype=np.uint8)
+        items.append(preproc.rgb_to_yuv420(rgb) if kind == "yuv420" else rgb)
+    return frame.encode_frame(items, frame.KIND_BY_WIRE_FORMAT[kind], edge)
+
+
+def synthetic_frame_pool(n: int, edge: int = 256, n_items: int = 8,
+                         kind: str = "yuv420",
+                         seed_base: int = 0) -> list[bytes]:
+    """``n`` distinct framed bodies (each of ``n_items`` images) — the
+    framed-wire miss-only pool (``--wire frame --distinct N``)."""
+    return [synthetic_frame(edge, n_items, kind, seed=seed_base + i)
+            for i in range(n)]
 
 
 def synthetic_prompt_pool(n: int, max_new: tuple[int, int] = (2, 32),
@@ -306,11 +336,139 @@ async def run_load_open(
     return result
 
 
-def run_loadgen_cli(args) -> int:
+def merge_load_summaries(parts: list[dict]) -> dict:
+    """Combine per-worker load results into one summary (multi-process
+    load generation, ISSUE 11 satellite).
+
+    Each part is a worker's ``{"summary": ..., "latencies_ms": [...]}``
+    dump. Counts sum; throughput sums (every worker measured its own
+    aligned window); percentiles are EXACT over the concatenated latency
+    samples — merging percentile-of-percentiles would lie about the tail."""
+    if not parts:
+        raise ValueError("no load-worker results to merge")
+    lats: list[float] = []
+    for p in parts:
+        lats.extend(p.get("latencies_ms", []))
+    summaries = [p["summary"] for p in parts]
+    base = summaries[0]
+    out = {
+        "mode": base["mode"],
+        "n_ok": sum(s["n_ok"] for s in summaries),
+        "n_err": sum(s["n_err"] for s in summaries),
+        "n_late": sum(s["n_late"] for s in summaries),
+        "duration_s": max(s["duration_s"] for s in summaries),
+        "throughput_per_s": round(
+            sum(s["throughput_per_s"] for s in summaries), 1),
+        "p50_ms": round(percentile(lats, 0.5), 3),
+        "p90_ms": round(percentile(lats, 0.9), 3),
+        "p99_ms": round(percentile(lats, 0.99), 3),
+        "load_workers": len(parts),
+    }
+    for key in ("items_per_request", "distinct_payloads",
+                "offered_rate_per_s"):
+        if key in base:
+            out[key] = base[key]
+    return out
+
+
+def _run_loadgen_multiproc(args, procs: int) -> int:
+    """Fan the load out over ``procs`` worker processes and merge.
+
+    One asyncio client process tops out around one core of HTTP work —
+    against an 8-chip server THAT becomes the bottleneck and the bench
+    under-reports the server (ISSUE 11 satellite). Workers split the
+    connection count (and open-loop rate) evenly, take DISJOINT synthetic
+    seed ranges (coalescing two workers' identical bodies would share
+    batch slots), and dump raw latencies for an exact merged summary."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
     batch = int(getattr(args, "batch", 0) or 0)
     distinct = int(getattr(args, "distinct", 0) or 0)
+    seed_base = int(getattr(args, "seed_base", 0) or 0)
+    rate = getattr(args, "rate", None)
+    conc = max(1, args.concurrency)
+    tmpdir = tempfile.mkdtemp(prefix="tpuserve-loadgen-")
+    workers = []
+    dumps = []
+    for i in range(procs):
+        c_i = conc // procs + (1 if i < conc % procs else 0)
+        if c_i <= 0:
+            continue
+        dump = os.path.join(tmpdir, f"worker{i}.json")
+        dumps.append(dump)
+        argv = [
+            sys.executable, "-m", "tpuserve", "bench",
+            "--url", args.url, "--model", args.model, "--verb", args.verb,
+            "--duration", str(args.duration),
+            "--warmup", str(getattr(args, "warmup", 2.0)),
+            "--concurrency", str(c_i),
+            "--content-type", args.content_type,
+            "--synthetic", getattr(args, "synthetic", "npy"),
+            "--edge", str(getattr(args, "edge", 256)),
+            "--wire", getattr(args, "wire", "npy"),
+            "--frame-kind", getattr(args, "frame_kind", "yuv420"),
+            "--max-new", str(getattr(args, "max_new", "2,32")),
+            "--procs", "1",
+            "--seed-base", str(seed_base + i * max(1, distinct)),
+            "--dump-latencies", dump,
+        ]
+        if batch:
+            argv += ["--batch", str(batch)]
+        if distinct:
+            argv += ["--distinct", str(distinct)]
+        if getattr(args, "payload", None):
+            argv += ["--payload", args.payload]
+        if rate:
+            argv += ["--rate", str(rate / procs)]
+        workers.append(subprocess.Popen(argv, stdout=subprocess.DEVNULL))
+    rcs = [w.wait() for w in workers]
+    parts = []
+    for dump in dumps:
+        try:
+            with open(dump, encoding="utf-8") as f:
+                parts.append(json.load(f))
+        except OSError:
+            pass  # a crashed worker: its rc already marks the failure
+    if not parts:
+        print(json.dumps({"error": "every load worker failed",
+                          "worker_rcs": rcs}))
+        return 1
+    merged = merge_load_summaries(parts)
+    print(json.dumps(merged))
+    return 0 if merged["n_ok"] > 0 and all(rc == 0 for rc in rcs) else 1
+
+
+def run_loadgen_cli(args) -> int:
+    procs = int(getattr(args, "procs", 1) or 1)
+    if procs > 1:
+        return _run_loadgen_multiproc(args, procs)
+    batch = int(getattr(args, "batch", 0) or 0)
+    distinct = int(getattr(args, "distinct", 0) or 0)
+    seed_base = int(getattr(args, "seed_base", 0) or 0)
     synth = getattr(args, "synthetic", "npy")
-    if distinct > 1 and synth in ("prompt", "sd-prompt"):
+    wire = getattr(args, "wire", "npy")
+    content_type = args.content_type
+    if wire == "frame":
+        # Framed-wire client batches (ISSUE 11): each POST is one
+        # multi-item application/x-tpuserve-frame body of --batch items
+        # (throughput counts items); --distinct cycles a disjoint-seed
+        # pool of framed bodies for miss-only workloads.
+        from tpuserve import frame
+
+        kind = getattr(args, "frame_kind", "yuv420")
+        edge = int(getattr(args, "edge", 256))
+        n_items = max(1, batch)
+        content_type = frame.CONTENT_TYPE
+        if distinct > 1:
+            payload = synthetic_frame_pool(distinct, edge, n_items, kind,
+                                           seed_base=seed_base)
+        else:
+            payload = synthetic_frame(edge, n_items, kind, seed=seed_base)
+        batch = n_items
+    elif distinct > 1 and synth in ("prompt", "sd-prompt"):
         # Generative workload: distinct (prompt, seed) bodies, mixed
         # max_new_tokens for textgen (the engine's early-exit/fold-in
         # counters only move when output lengths mix).
@@ -323,7 +481,8 @@ def run_loadgen_cli(args) -> int:
         # round-robin (a pool larger than the server's cache capacity makes
         # every lookup an LRU miss).
         payload = synthetic_pool(synth, distinct,
-                                 int(getattr(args, "edge", 256)), batch)
+                                 int(getattr(args, "edge", 256)), batch,
+                                 seed_base=seed_base)
     elif args.payload:
         with open(args.payload, "rb") as f:
             payload = f.read()
@@ -337,11 +496,17 @@ def run_loadgen_cli(args) -> int:
     rate = getattr(args, "rate", None)
     if rate:
         result = asyncio.run(run_load_open(
-            url, payload, args.content_type, rate, args.duration, warmup,
+            url, payload, content_type, rate, args.duration, warmup,
             items_per_request=items))
     else:
         result = asyncio.run(run_load(
-            url, payload, args.content_type, args.duration, args.concurrency,
+            url, payload, content_type, args.duration, args.concurrency,
             warmup, items_per_request=items))
+    dump = getattr(args, "dump_latencies", None)
+    if dump:
+        # Raw samples for the multi-process merge (exact percentiles).
+        with open(dump, "w", encoding="utf-8") as f:
+            json.dump({"summary": result.summary(),
+                       "latencies_ms": result.latencies_ms}, f)
     print(json.dumps(result.summary()))
     return 0 if result.n_ok > 0 else 1

@@ -30,10 +30,21 @@ from __future__ import annotations
 import math
 
 # Phases with a measurable physical ceiling, and what prices it:
-# h2d against the measured link rate at the serving transfer size, compute
-# against the bucket's raw-executable probe. queue/preproc/postproc are
+# body_read against the measured link rate at the actual request-body size
+# (the ingest wire — with the framed format, bytes-per-item is
+# frame.item_nbytes plus the amortized header/table), h2d against the
+# measured link rate at the serving transfer size, compute against the
+# bucket's raw-executable probe. parse/queue/preproc/postproc are
 # host-side bookkeeping with no hardware floor — reported, not ratioed.
-ROOFLINE_CEILINGS = {"h2d": "wire", "compute": "device"}
+ROOFLINE_CEILINGS = {"body_read": "wire", "h2d": "wire", "compute": "device"}
+
+# Attribution order (ingest phases first — ISSUE 11): body_read and parse
+# are request-scoped (observed by the HTTP layer), the rest batch-scoped.
+# With the framed wire carrying one device bucket per POST the two scales
+# are directly comparable; with single-item POSTs a request is 1/bucket of
+# a batch — read the per_bucket rows before comparing across the seam.
+ROOFLINE_PHASES = ("body_read", "parse", "queue", "preproc", "h2d",
+                   "compute", "postproc")
 
 
 def best_window(values: list[float], k: int = 3) -> tuple[int, list[float]]:
@@ -116,7 +127,8 @@ def build_roofline(latency_summary: dict, model: str, buckets: list[int],
                    link_mbps: float, img_bytes: int,
                    chip_img_s: float | None,
                    value_img_s: float | None,
-                   n_chips: int = 1) -> dict:
+                   n_chips: int = 1,
+                   req_bytes: int | None = None) -> dict:
     """The bench/``/stats`` ``roofline`` block for one model.
 
     ``raw_ms_by_bucket`` maps batch size -> raw-executable ms/batch (None
@@ -129,7 +141,12 @@ def build_roofline(latency_summary: dict, model: str, buckets: list[int],
     the serving path has n_chips of those, so ``pct_of_chip_ceiling`` is
     taken against the aggregate (chip_img_s x n_chips) — an 8-chip run
     reporting 100% of one chip's ceiling is at 12.5% of the hardware it
-    holds, and the block must say so (ISSUE 7)."""
+    holds, and the block must say so (ISSUE 7).
+
+    ``req_bytes`` (ISSUE 11) is the actual HTTP request-body size the load
+    used — for the framed wire, ``frame.frame_nbytes(kind, edge, items)``
+    — pricing the ``body_read`` ingest phase against the link the same way
+    ``h2d`` is priced."""
     top = max(buckets) if buckets else None
     per_bucket: dict[str, dict] = {}
     for b in sorted(buckets):
@@ -141,12 +158,14 @@ def build_roofline(latency_summary: dict, model: str, buckets: list[int],
             "wire_ms_per_batch": round(wire, 3) if wire else None,
         }
     ceilings = {
+        "body_read": (req_bytes / (link_mbps * 1e6) * 1e3
+                      if req_bytes and link_mbps and link_mbps > 0 else None),
         "h2d": wire_ms_per_batch(top, img_bytes, link_mbps) if top else None,
         "compute": raw_ms_by_bucket.get(top) if top else None,
     }
     phases: dict[str, dict] = {}
     binding, binding_ms = None, -1.0
-    for phase in ("queue", "preproc", "h2d", "compute", "postproc"):
+    for phase in ROOFLINE_PHASES:
         p50 = phase_p50(latency_summary, model, phase)
         row: dict = {"p50_ms": round(p50, 3) if p50 is not None else None}
         ceil = ceilings.get(phase)
@@ -167,6 +186,8 @@ def build_roofline(latency_summary: dict, model: str, buckets: list[int],
             ceilings.get("compute")),
         "binding_phase": binding,
     }
+    if req_bytes:
+        out["ingest_req_bytes"] = int(req_bytes)
     if chip_img_s and value_img_s is not None:
         n = max(1, n_chips)
         aggregate = chip_img_s * n

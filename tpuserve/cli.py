@@ -81,6 +81,29 @@ def main(argv: list[str] | None = None) -> int:
                          help="lo,hi range of max_new_tokens for "
                               "--synthetic prompt pools (mixed output "
                               "lengths; ISSUE 9)")
+    p_bench.add_argument("--wire", choices=["npy", "frame"], default="npy",
+                         help="client wire: npy bodies, or framed binary "
+                              "multi-item bodies (application/"
+                              "x-tpuserve-frame — zero-copy server parse; "
+                              "--batch sets items per frame, --frame-kind "
+                              "the pixel layout)")
+    p_bench.add_argument("--frame-kind", choices=["yuv420", "rgb8"],
+                         default="yuv420",
+                         help="--wire frame item layout; must match the "
+                              "served model's wire_format")
+    p_bench.add_argument("--procs", type=int, default=1,
+                         help="load-worker processes; > 1 splits "
+                              "--concurrency (and --rate) across workers "
+                              "with disjoint synthetic seed ranges and "
+                              "merges exact percentiles — so the measured "
+                              "bottleneck is the server, not one client "
+                              "process's event loop")
+    p_bench.add_argument("--seed-base", type=int, default=0,
+                         help="first synthetic seed (multi-process workers "
+                              "take disjoint ranges automatically)")
+    p_bench.add_argument("--dump-latencies", default=None,
+                         help="write raw latency samples as JSON to this "
+                              "path (the multi-process merge reads them)")
 
     p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
     p_imp.add_argument("--saved-model", required=True)

@@ -639,6 +639,15 @@ class ServerConfig:
     # Worker-process knobs for the router split (loopback bind, drain).
     worker: WorkerConfig = field(default_factory=WorkerConfig)
     models: list[ModelConfig] = field(default_factory=list)
+    # Parallel ingest (docs/PERFORMANCE.md "The ingest fast path"): total
+    # HTTP accept loops on the serving port. 1 = the classic single event
+    # loop. N > 1 adds N-1 dedicated ingest event-loop THREADS, each with
+    # its own SO_REUSEPORT listener on the same port, so the kernel spreads
+    # connections and body read / frame parse / JSON encode stop
+    # serializing on one loop — the loop that owns the batchers only runs
+    # admission + dispatch (handlers hop to it via a loop-safe entry).
+    # Per-loop balance is visible as ingest_requests_total{loop=}.
+    ingest_loops: int = 1
     # Host-side decode threadpool size.
     decode_threads: int = 8
     # Decode request bodies inline on the event loop instead of hopping to
@@ -693,6 +702,11 @@ class ServerConfig:
     drain_timeout_s: float = 30.0
     # Retry-After hint (seconds) on 429 shed and drain 503 responses.
     shed_retry_after_s: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.ingest_loops < 1:
+            raise ValueError(
+                f"ingest_loops must be >= 1, got {self.ingest_loops}")
 
     def model(self, name: str) -> ModelConfig:
         for m in self.models:

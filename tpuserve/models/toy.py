@@ -59,11 +59,16 @@ class ToyServing(ServingModel):
         return preproc.decode_image(payload, content_type, edge=EDGE)
 
     def host_decode_items(self, payload: bytes, content_type: str) -> tuple[list, bool]:
-        """npy client batches, sharing the vision probe (one parse)."""
+        """Framed (zero-copy) and npy client batches, sharing the vision
+        wire contracts (one parse either way)."""
+        from tpuserve import frame, preproc
+
+        if content_type == frame.CONTENT_TYPE:
+            return frame.parse_frame(
+                payload, kind=frame.KIND_RGB8, edge=EDGE,
+                max_items=self.MAX_ITEMS_PER_REQUEST), True
         if content_type != "application/x-npy":
             return [self.host_decode(payload, content_type)], False
-        from tpuserve import preproc
-
         return preproc.decode_npy_items(payload, EDGE, self.MAX_ITEMS_PER_REQUEST)
 
     def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:

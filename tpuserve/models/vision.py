@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpuserve import preproc
+from tpuserve import frame, preproc
 from tpuserve.config import ModelConfig
 from tpuserve.models.base import ServingModel
 
@@ -84,12 +84,23 @@ class ImageClassifierServing(ServingModel):
 
     def host_decode(self, payload: bytes, content_type: str) -> Any:
         if self.cfg.wire_format == "yuv420":
-            return preproc.decode_image_yuv420(payload, content_type, self.cfg.wire_size)
+            return preproc.decode_image_yuv420(
+                payload, content_type, self.cfg.wire_size, model=self.name)
         return preproc.decode_image(payload, content_type, edge=self.cfg.wire_size)
 
     def host_decode_items(self, payload: bytes, content_type: str) -> tuple[list, bool]:
-        """npy bodies parse once: (N, H, W, 3) is a client batch, (H, W, 3)
-        a single item; other content types take the single-image path."""
+        """Framed bodies parse zero-copy (the ingest fast path); npy bodies
+        parse once: (N, H, W, 3) is a client batch, (H, W, 3) a single
+        item; other content types take the single-image path."""
+        if content_type == frame.CONTENT_TYPE:
+            # Zero-copy frame views at the model's exact wire contract; the
+            # one copy happens in assemble_into (tpuserve.frame docstring).
+            items = frame.parse_frame(
+                payload,
+                kind=frame.KIND_BY_WIRE_FORMAT[self.cfg.wire_format],
+                edge=self.cfg.wire_size,
+                max_items=self.MAX_ITEMS_PER_REQUEST)
+            return items, True
         if content_type != "application/x-npy":
             return [self.host_decode(payload, content_type)], False
         items, batched = preproc.decode_npy_items(

@@ -155,7 +155,15 @@ class Tracer:
         return json.dumps({"traceEvents": out})
 
 
-PHASES = ("queue", "preproc", "h2d", "compute", "postproc", "total")
+# Per-request/per-batch phase labels on latency_ms{model=,phase=}. The
+# ingest phases (ISSUE 11) are request-scoped and observed by the HTTP
+# layer — "body_read" is the time to read the request body off the socket
+# (the HTTP ingress wire), "parse" the host decode/frame-parse time; the
+# rest are batch-scoped and observed by the batcher. Together with the
+# roofline ceilings they attribute where an ingest-bound config loses time
+# (docs/PERFORMANCE.md "The ingest fast path").
+PHASES = ("body_read", "parse", "queue", "preproc", "h2d", "compute",
+          "postproc", "total")
 
 # Host-pipeline stage executors (tpuserve.hostpipe, docs/PERFORMANCE.md):
 # the stage label on pipeline_stage_depth{model=,stage=} and the keys of the
@@ -272,6 +280,23 @@ class Metrics:
         start — never call per batch."""
         return self.gauge(
             f"replica_inflight{{model={model},replica={replica}}}")
+
+    def ingest_requests_counter(self, loop_index: int) -> Counter:
+        """ingest_requests_total{loop=}: predict requests served by one
+        ingest accept loop (loop 0 = the main serving loop; 1..N-1 the
+        dedicated SO_REUSEPORT ingest threads, tpuserve.server). Roughly
+        equal values across loops under load = the kernel is spreading
+        connections and no single accept loop is the choke point; one hot
+        loop = clients are reusing few connections (ISSUE 11). Prebound
+        per app at construction — never call per request."""
+        return self.counter(f"ingest_requests_total{{loop={loop_index}}}")
+
+    def ingest_bytes_counter(self, loop_index: int) -> Counter:
+        """ingest_bytes_total{loop=}: request-body bytes read by one ingest
+        accept loop — the ingress-wire balance twin of
+        ingest_requests_total (big framed bodies make byte balance the
+        honest signal). Prebound per app at construction."""
+        return self.counter(f"ingest_bytes_total{{loop={loop_index}}}")
 
     def worker_up_gauge(self, worker: int) -> Gauge:
         """worker_up{worker=}: 1 while the supervised worker process is

@@ -146,13 +146,37 @@ def device_prepare_images_yuv420(
     return x.astype(dtype)
 
 
-def decode_image_yuv420(payload: bytes, content_type: str, edge: int):
+# Native-fallback observability hook (ISSUE 11 satellite): installed by the
+# server (ServerState.start) to tick native_decode_fallback_total{model=}
+# whenever the libjpeg shim path was attempted but the slow PIL re-subsample
+# path served instead — a missing/failed libjpegyuv.so is ~2x slower per
+# JPEG and must never be silent. None (tests, offline tools) = no counting.
+_native_fallback_hook = None
+
+
+def set_native_fallback_hook(cb) -> None:
+    """Install ``cb(model_name)`` as the native-decode fallback observer
+    (thread-safe: decode runs in the threadpool / ingest loops)."""
+    global _native_fallback_hook
+    _native_fallback_hook = cb
+
+
+def _note_native_fallback(model: str) -> None:
+    cb = _native_fallback_hook
+    if cb is not None:
+        cb(model)
+
+
+def decode_image_yuv420(payload: bytes, content_type: str, edge: int,
+                        model: str = "") -> tuple:
     """Bytes -> (y, u, v) uint8 planes at the wire edge (threadpool).
 
     Fast path: the native libjpeg shim decodes exact-size 4:2:0 JPEGs
     straight to planes. Fallback (non-JPEG, size mismatch, no shim): PIL
     decode -> YCbCr -> numpy re-subsample, so the wire contract holds for
-    every input the RGB path accepts.
+    every input the RGB path accepts — but it is ~2x slower, so every
+    fallback on a native-eligible request is counted via
+    ``native_decode_fallback_total{model=}`` (the ``model`` arg labels it).
     """
     if content_type not in ("application/x-npy",):
         from tpuserve import native
@@ -160,6 +184,10 @@ def decode_image_yuv420(payload: bytes, content_type: str, edge: int):
         res = native.decode_yuv420(payload, edge)
         if res is not None:
             return res
+        # The native path was attempted and declined (shim missing, build
+        # failed, or not an exact-size baseline 4:2:0 JPEG): the 2x-slower
+        # PIL path serves this request, visibly.
+        _note_native_fallback(model)
     rgb = decode_image(payload, content_type, edge=edge)
     return rgb_to_yuv420(rgb)
 

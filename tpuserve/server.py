@@ -40,13 +40,17 @@ import logging
 import math
 import os
 import signal
+import socket
+import threading
 import time
 
 from aiohttp import web
 
 import jax
 
+from tpuserve import frame as frame_wire
 from tpuserve import models as modelzoo
+from tpuserve import preproc
 from tpuserve.analysis import witness
 from tpuserve.batcher import (DeadlineExceeded, ModelBatcher, QueueFull,
                               clamp_retry_after_s)
@@ -65,8 +69,10 @@ log = logging.getLogger("tpuserve.server")
 
 _VERBS = ("predict", "classify", "detect", "generate")
 
-# Typed aiohttp app key (string keys are deprecated).
+# Typed aiohttp app keys (string keys are deprecated).
 STATE_KEY: "web.AppKey[ServerState]" = web.AppKey("tpuserve_state", object)
+# Per-app ingest handles: which accept loop this app serves (ISSUE 11).
+INGEST_KEY: "web.AppKey[IngestHandles]" = web.AppKey("tpuserve_ingest", object)
 
 # Client batches at least this big JSON-encode off the event loop (the
 # encode for a full bucket of top-k results is hundreds of microseconds —
@@ -90,7 +96,9 @@ class ModelHandles:
     previously paid an f-string format plus a locked registry lookup per
     counter per request, and a linear config scan per request."""
 
-    __slots__ = ("mcfg", "requests", "bad_requests", "timeouts", "total_hist")
+    __slots__ = ("mcfg", "requests", "bad_requests", "timeouts", "total_hist",
+                 "body_read_hist", "parse_hist", "frame_errors",
+                 "native_fallback")
 
     def __init__(self, name: str, mcfg, metrics: Metrics) -> None:
         self.mcfg = mcfg
@@ -100,6 +108,37 @@ class ModelHandles:
         self.timeouts = metrics.counter(f"timeouts_total{{model={name}}}")
         self.total_hist = metrics.histogram(
             f"latency_ms{{model={name},phase=total}}")
+        # Ingest-phase attribution (ISSUE 11, docs/PERFORMANCE.md "The
+        # ingest fast path"): body_read = socket-to-memory time for the
+        # request body (the HTTP ingress wire), parse = host decode /
+        # zero-copy frame parse. Request-scoped twins of the batcher's
+        # batch-scoped phases, same latency_ms{phase=} family.
+        self.body_read_hist = metrics.histogram(
+            f"latency_ms{{model={name},phase=body_read}}")
+        self.parse_hist = metrics.histogram(
+            f"latency_ms{{model={name},phase=parse}}")
+        # Malformed application/x-tpuserve-frame bodies (every one also
+        # counts in bad_requests_total; this isolates wire-format trouble).
+        self.frame_errors = metrics.counter(
+            f"frame_errors_total{{model={name}}}")
+        # yuv420 decode served by the 2x-slower PIL fallback although the
+        # native shim path was attempted (missing/failed libjpegyuv.so or
+        # a non-4:2:0 input); fed by the preproc hook installed at start().
+        self.native_fallback = metrics.counter(
+            f"native_decode_fallback_total{{model={name}}}")
+
+
+class IngestHandles:
+    """Per-accept-loop prebound ingest counters (ISSUE 11): loop 0 is the
+    main serving loop, 1..N-1 the dedicated SO_REUSEPORT ingest threads.
+    Balance across loops proves no single accept loop chokes the mesh."""
+
+    __slots__ = ("index", "requests", "bytes")
+
+    def __init__(self, index: int, metrics: Metrics) -> None:
+        self.index = index
+        self.requests = metrics.ingest_requests_counter(index)
+        self.bytes = metrics.ingest_bytes_counter(index)
 
 
 class ServerState:
@@ -140,6 +179,14 @@ class ServerState:
         self.scheduler = (FleetScheduler(cfg.scheduler, self.metrics)
                           if cfg.scheduler.enabled else None)
         self.canary_ok: dict[str, bool] = {}
+        # The event loop that owns the batchers/engines/cache/scheduler
+        # (set in start()). Handlers running on a parallel ingest loop
+        # (cfg.ingest_loops > 1) hop their submission onto it; on the main
+        # loop the hop is a no-op (_on_main).
+        self.main_loop: asyncio.AbstractEventLoop | None = None
+        # Per-accept-loop ingest counters, keyed by loop index (built
+        # lazily by make_app; the /stats "ingest" block reads them).
+        self.ingest: dict[int, IngestHandles] = {}
         self._canary_task: asyncio.Task | None = None
         # Next periodic-canary fire time (time.monotonic clock): the live
         # basis for breaker-503 Retry-After hints (the canary IS the
@@ -248,7 +295,15 @@ class ServerState:
         finally:
             compile_pool.shutdown()
 
+    def ingest_handles(self, index: int) -> IngestHandles:
+        """Prebound ingest counters for accept loop ``index`` (idempotent)."""
+        h = self.ingest.get(index)
+        if h is None:
+            h = self.ingest[index] = IngestHandles(index, self.metrics)
+        return h
+
     async def start(self) -> None:
+        self.main_loop = asyncio.get_running_loop()
         # Debug-mode race detection (docs/ANALYSIS.md): with
         # TPUSERVE_LOCK_WITNESS=1 every task created on this loop checks at
         # each suspension that no witnessed threading lock is held across an
@@ -318,6 +373,12 @@ class ServerState:
                     name, batcher=b, mcfg=model.cfg, runtime=rt,
                     warm_fn=lc.reload if lc is not None else None,
                     cold=bool(model.cfg.cold_start))
+        # Native-decode fallback observability (ISSUE 11 satellite): the
+        # preproc yuv420 decoder reports every PIL fallback on a
+        # native-eligible request; route it to the prebound per-model
+        # counter (Counter.inc is locked — decode threads and ingest loops
+        # may call this concurrently).
+        preproc.set_native_fallback_hook(self._note_native_fallback)
         if self.scheduler is not None:
             await self.scheduler.start()
         if self.cfg.startup_canary:
@@ -325,6 +386,14 @@ class ServerState:
         if self.cfg.canary_interval_s > 0:
             self._canary_task = asyncio.create_task(self._canary_loop())
         self.watchdog.start()
+
+    def _note_native_fallback(self, model: str) -> None:
+        h = self.handles.get(model)
+        if h is not None:
+            h.native_fallback.inc()
+        else:  # decode racing startup/teardown: count unlabeled-but-visible
+            self.metrics.counter(
+                f"native_decode_fallback_total{{model={model}}}").inc()
 
     async def _canary_loop(self) -> None:
         """Re-run the per-model canary on an interval so /healthz reflects
@@ -550,6 +619,115 @@ class ServerState:
 
 # -- handlers ----------------------------------------------------------------
 
+class NotServing(RuntimeError):
+    """Batcher refused the submit (stopped / racing shutdown) -> 503."""
+
+
+async def _on_main(state: ServerState, factory):
+    """Run ``factory()`` (a coroutine factory) on the main serving loop.
+
+    On the main loop this is a plain await — the single-loop hot path pays
+    nothing. On a parallel ingest loop (cfg.ingest_loops > 1) the coroutine
+    is scheduled onto the main loop, which owns every batcher/cache/
+    scheduler structure (all deliberately lock-free and loop-only), and the
+    result/exception crosses back through a concurrent future. Cancelling
+    the ingest-side await (client disconnect) cancels the main-loop task —
+    asyncio.wrap_future propagates cancellation both ways."""
+    loop = asyncio.get_running_loop()
+    if state.main_loop is None or loop is state.main_loop:
+        return await factory()
+    cfut = asyncio.run_coroutine_threadsafe(factory(), state.main_loop)
+    return await asyncio.wrap_future(cfut)
+
+
+def _main_loop_handler(handler):
+    """Route an admin/stats handler onto the main serving loop when the
+    request landed on a parallel ingest loop. These handlers touch
+    loop-only state (lifecycles, scheduler, batcher stats) and read only
+    ``request.match_info`` — synchronous data, safe to carry across the
+    loop boundary; the Response is built unprepared and returned."""
+
+    @functools.wraps(handler)
+    async def wrapped(request: web.Request) -> web.StreamResponse:
+        state: ServerState = request.app[STATE_KEY]
+        loop = asyncio.get_running_loop()
+        if state.main_loop is None or loop is state.main_loop:
+            return await handler(request)
+        cfut = asyncio.run_coroutine_threadsafe(handler(request),
+                                                state.main_loop)
+        return await asyncio.wrap_future(cfut)
+
+    return wrapped
+
+
+async def _submit_and_gather(state: ServerState, name: str, model,
+                             items: list, deadline_at: float,
+                             priority: str | None,
+                             timeout_ms: float | None,
+                             ) -> tuple[list, "object | None"]:
+    """Cache/single-flight lookup + batcher submission + deadline-bounded
+    gather for one decoded request — everything that must run on the main
+    serving loop. Returns (results, hit_entry). Raises QueueFull (-> 429),
+    NotServing (-> 503), DeadlineExceeded (-> fast 504),
+    asyncio.TimeoutError (-> backstop 504), or the batch failure (-> 500);
+    the HTTP handler owns the status mapping on whichever loop it runs."""
+    cache = state.caches.get(name)
+    batcher = state.batchers[name]
+    results: list = [None] * len(items)
+    futs: list[asyncio.Future] = []
+    slots: list[int] = []
+    hit_entry = None
+    try:
+        for i, item in enumerate(items):
+            if cache is not None:
+                key = cache.key_for(item)
+                entry = cache.get(key)
+                if entry is not None:
+                    results[i] = entry.value
+                    hit_entry = entry
+                    continue
+                fut = cache.submit_through(
+                    key, lambda it=item: batcher.submit(
+                        it, group=model.group_key(it),
+                        deadline_at=deadline_at, priority=priority))
+            else:
+                fut = batcher.submit(item, group=model.group_key(item),
+                                     deadline_at=deadline_at,
+                                     priority=priority)
+            futs.append(fut)
+            slots.append(i)
+    except QueueFull:
+        for f in futs:
+            f.cancel()
+        raise
+    except RuntimeError as e:
+        # Batcher stopped/not started: requests racing shutdown get a clean
+        # retryable status instead of an unhandled 500.
+        for f in futs:
+            f.cancel()
+        raise NotServing(str(e)) from e
+
+    if futs:
+        try:
+            remaining = max(0.0, deadline_at - time.perf_counter())
+            # With an explicit client deadline the batcher enforces it
+            # precisely at flush time (fast 504 + deadline_exceeded_total);
+            # the timer here then runs slightly late as a pure backstop so
+            # the two never race.
+            grace = 0.25 if timeout_ms is not None else 0.0
+            done = await asyncio.wait_for(asyncio.gather(*futs),
+                                          timeout=remaining + grace)
+        except BaseException:
+            # TimeoutError, DeadlineExceeded, batch failure, cancellation:
+            # nothing may leave dangling single-item futures behind.
+            for f in futs:
+                f.cancel()
+            raise
+        for i, res in zip(slots, done):
+            results[i] = res
+    return results, hit_entry
+
+
 async def handle_predict(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     name = request.match_info["name"]
@@ -570,19 +748,26 @@ async def handle_predict(request: web.Request) -> web.Response:
     # Fleet scheduler admission, part 1 (pre-body; tpuserve.scheduler):
     # warm/cold state and priority arbitration need only headers, so a
     # cold model or shed batch-class request answers in microseconds. The
-    # deadline check runs after the deadline is stamped, below.
+    # deadline check runs after the deadline is stamped, below. Scheduler
+    # state is main-loop-only; on a parallel ingest loop the check hops
+    # (_on_main) — microseconds of coroutine scheduling, still pre-body.
     raw_priority = request.headers.get("X-Priority")
     priority: str | None = None
     if state.scheduler is not None:
+        async def _precheck():
+            p = state.scheduler.resolve_priority(name, raw_priority)
+            shed = state.scheduler.check_admission(name, p)
+            if shed is None:
+                state.scheduler.touch(name)
+            return p, shed
+
         try:
-            priority = state.scheduler.resolve_priority(name, raw_priority)
+            priority, shed = await _on_main(state, _precheck)
         except ValueError as e:
             return _err(400, str(e))
-        shed = state.scheduler.check_admission(name, priority)
         if shed is not None:
             return _err(shed.status, shed.message,
                         retry_after=shed.retry_after, reason=shed.reason)
-        state.scheduler.touch(name)
     elif raw_priority:
         # No scheduler = no arbitration, but the class still labels the
         # queue-wait split (header -> batcher); junk degrades to the
@@ -613,7 +798,16 @@ async def handle_predict(request: web.Request) -> web.Response:
                       name)
             os._exit(17)
 
+    # Ingest phase 1 (ISSUE 11): the body read is the HTTP ingress wire —
+    # on a framed multi-item POST this is megabytes off the socket, and
+    # with ingest_loops > 1 it runs on whichever accept loop the kernel's
+    # SO_REUSEPORT spread picked, not serialized on the batcher's loop.
+    ing: IngestHandles = request.app[INGEST_KEY]
+    t_read = time.perf_counter()
     body = await request.read()
+    h.body_read_hist.observe((time.perf_counter() - t_read) * 1e3)
+    ing.requests.inc()
+    ing.bytes.inc(len(body))
     ctype = request.content_type or ""
 
     # Per-request deadline (docs/ROBUSTNESS.md): the client's timeout_ms
@@ -634,7 +828,10 @@ async def handle_predict(request: web.Request) -> web.Response:
     # the remaining budget — sheds with a fast 504 BEFORE decode or
     # enqueue, instead of dying at the back of the queue.
     if state.scheduler is not None:
-        shed = state.scheduler.check_deadline(name, deadline_at)
+        async def _deadline_check():
+            return state.scheduler.check_deadline(name, deadline_at)
+
+        shed = await _on_main(state, _deadline_check)
         if shed is not None:
             return _err(shed.status, shed.message,
                         retry_after=shed.retry_after, reason=shed.reason)
@@ -642,8 +839,11 @@ async def handle_predict(request: web.Request) -> web.Response:
     try:
         if state.injector is not None:
             state.injector.check("decode_corrupt", name)
-        # (items, is_batch) with one parse; a 1-element client batch still
-        # answers in the {"results": [...]} shape.
+        # Ingest phase 2: (items, is_batch) with one parse; a 1-element
+        # client batch still answers in the {"results": [...]} shape.
+        # Framed bodies parse as zero-copy views (tpuserve.frame) — the
+        # "parse" phase for them is offset-table validation, not pixel work.
+        t_parse = time.perf_counter()
         if state.cfg.decode_inline:
             items, batched = model.host_decode_items(body, ctype)
         else:
@@ -652,6 +852,13 @@ async def handle_predict(request: web.Request) -> web.Response:
                 state.pool, model.host_decode_items, body, ctype)
         if not items:
             raise ValueError("empty batch")
+        h.parse_hist.observe((time.perf_counter() - t_parse) * 1e3)
+    except frame_wire.FrameError as e:
+        # Malformed frame: machine-readable 400 (message is "frame: ..."),
+        # never a 500 — and counted apart from generic decode failures.
+        h.frame_errors.inc()
+        h.bad_requests.inc()
+        return _err(400, str(e))
     except Exception as e:
         h.bad_requests.inc()
         return _err(400, f"could not decode request: {e}")
@@ -660,72 +867,30 @@ async def handle_predict(request: web.Request) -> web.Response:
     # content-addressed result cache, join an identical in-flight miss
     # (single-flight: one batch slot, the result fanned out), or lead a
     # fresh batcher submission. Hit/miss/coalesced are counted disjointly
-    # so cache traffic never masquerades as model throughput.
-    cache = state.caches.get(name)
-    batcher = state.batchers[name]
-    results: list = [None] * len(items)
-    futs: list[asyncio.Future] = []
-    slots: list[int] = []
-    hit_entry = None
+    # so cache traffic never masquerades as model throughput. Everything
+    # below the decode runs on the MAIN loop (_submit_and_gather): cache,
+    # single-flight, batcher, and scheduler state are loop-only by design,
+    # so a parallel ingest loop makes exactly ONE hop per request here.
     try:
-        for i, item in enumerate(items):
-            if cache is not None:
-                key = cache.key_for(item)
-                entry = cache.get(key)
-                if entry is not None:
-                    results[i] = entry.value
-                    hit_entry = entry
-                    continue
-                fut = cache.submit_through(
-                    key, lambda it=item: batcher.submit(
-                        it, group=model.group_key(it),
-                        deadline_at=deadline_at, priority=priority))
-            else:
-                fut = batcher.submit(item, group=model.group_key(item),
-                                     deadline_at=deadline_at,
-                                     priority=priority)
-            futs.append(fut)
-            slots.append(i)
+        results, hit_entry = await _on_main(
+            state, lambda: _submit_and_gather(
+                state, name, model, items, deadline_at, priority,
+                timeout_ms))
     except QueueFull:
-        for f in futs:
-            f.cancel()
         return _err(429, "queue full, retry later",
                     retry_after=state.queue_retry_after(name))
-    except RuntimeError as e:
-        # Batcher stopped/not started: requests racing shutdown get a clean
-        # retryable status instead of an unhandled 500.
-        for f in futs:
-            f.cancel()
+    except NotServing as e:
         return _err(503, f"server not accepting requests: {e}")
-
-    if futs:
-        try:
-            remaining = max(0.0, deadline_at - time.perf_counter())
-            # With an explicit client deadline the batcher enforces it
-            # precisely at flush time (fast 504 + deadline_exceeded_total);
-            # the HTTP timer then runs slightly late as a pure backstop so
-            # the two never race.
-            grace = 0.25 if timeout_ms is not None else 0.0
-            done = await asyncio.wait_for(asyncio.gather(*futs),
-                                          timeout=remaining + grace)
-        except asyncio.TimeoutError:
-            for f in futs:
-                f.cancel()
-            h.timeouts.inc()
-            return _err(504,
-                        f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
-        except DeadlineExceeded as e:
-            # The batcher rejected the queued work before dispatch: same 504
-            # as the timer path, but fast, in deadline_exceeded_total.
-            for f in futs:
-                f.cancel()
-            return _err(504, f"deadline_exceeded: {e}")
-        except Exception as e:
-            for f in futs:
-                f.cancel()
-            return _err(500, f"inference failed: {e}")
-        for i, res in zip(slots, done):
-            results[i] = res
+    except DeadlineExceeded as e:
+        # The batcher rejected the queued work before dispatch: same 504
+        # as the timer path, but fast, in deadline_exceeded_total.
+        return _err(504, f"deadline_exceeded: {e}")
+    except asyncio.TimeoutError:
+        h.timeouts.inc()
+        return _err(504,
+                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
+    except Exception as e:
+        return _err(500, f"inference failed: {e}")
 
     total_ms = (time.perf_counter() - t_start) * 1e3
     h.total_hist.observe(total_ms)
@@ -794,6 +959,19 @@ async def handle_stats(request: web.Request) -> web.Response:
     if state.lifecycles:
         out["lifecycle"] = {n: lc.describe()
                             for n, lc in state.lifecycles.items()}
+    # Ingest fast path (ISSUE 11, docs/PERFORMANCE.md "The ingest fast
+    # path"): per-accept-loop request/byte balance, malformed-frame counts,
+    # and the native-decode fallback tallies (a nonzero fallback row under
+    # JPEG load means the 2x-slower PIL path is serving — fix the shim).
+    out["ingest"] = {
+        "loops": {str(i): {"requests": ih.requests.value,
+                           "bytes": ih.bytes.value}
+                  for i, ih in sorted(state.ingest.items())},
+        "frame_errors_total": {n: hd.frame_errors.value
+                               for n, hd in state.handles.items()},
+        "native_decode_fallback_total": {
+            n: hd.native_fallback.value for n, hd in state.handles.items()},
+    }
     # Host-pipeline state (docs/PERFORMANCE.md "Reading the metrics"):
     # per-stage executor sizes/queue depth and, per model, the in-flight
     # occupancy, staging-slot usage, and assembly-arena recycling stats.
@@ -984,31 +1162,151 @@ def _requested_timeout_ms(request: web.Request, body: bytes,
 
 # -- app wiring --------------------------------------------------------------
 
-def make_app(state: ServerState) -> web.Application:
+def make_app(state: ServerState, loop_index: int = 0,
+             primary: bool = True) -> web.Application:
+    """Build the aiohttp app for one accept loop.
+
+    ``loop_index`` labels the per-loop ingest counters (0 = main loop).
+    ``primary=False`` (a parallel ingest loop, ISSUE 11) skips the
+    startup/cleanup hooks — the main app owns the ServerState lifecycle;
+    ingest apps only share it. Admin and /stats handlers are wrapped so a
+    request landing on an ingest loop executes on the main loop, where
+    lifecycles/scheduler/batcher state lives."""
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app[STATE_KEY] = state
+    app[INGEST_KEY] = state.ingest_handles(loop_index)
     for verb in _VERBS:
         app.router.add_post(f"/v1/models/{{name}}:{verb}", handle_predict)
     app.router.add_get("/v1/models", handle_models)
-    app.router.add_post("/admin/models/{name}:reload", handle_reload)
-    app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
-    app.router.add_post("/admin/models/{name}:warm", handle_warm)
-    app.router.add_get("/admin/models/{name}/versions", handle_versions)
+    app.router.add_post("/admin/models/{name}:reload",
+                        _main_loop_handler(handle_reload))
+    app.router.add_post("/admin/models/{name}:rollback",
+                        _main_loop_handler(handle_rollback))
+    app.router.add_post("/admin/models/{name}:warm",
+                        _main_loop_handler(handle_warm))
+    app.router.add_get("/admin/models/{name}/versions",
+                       _main_loop_handler(handle_versions))
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
-    app.router.add_get("/stats", handle_stats)
+    app.router.add_get("/stats", _main_loop_handler(handle_stats))
     app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/", handle_index)
 
-    async def on_startup(app: web.Application) -> None:
-        await state.start()
+    if primary:
+        async def on_startup(app: web.Application) -> None:
+            await state.start()
 
-    async def on_cleanup(app: web.Application) -> None:
-        await state.stop()
+        async def on_cleanup(app: web.Application) -> None:
+            await state.stop()
 
-    app.on_startup.append(on_startup)
-    app.on_cleanup.append(on_cleanup)
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
     return app
+
+
+# -- parallel ingest loops (ISSUE 11) -----------------------------------------
+
+class IngestLoop(threading.Thread):
+    """One dedicated ingest accept loop: its own thread, its own asyncio
+    event loop, its own SO_REUSEPORT listener on the serving port.
+
+    The kernel spreads incoming connections across every listener on the
+    port, so HTTP parse, body reads, request decode (frame parse /
+    decode_inline), and JSON response encode for this loop's connections
+    never serialize on the main loop; handlers hop their submission onto
+    the main loop via ``_on_main`` (one hop per request). The thread is a
+    daemon: a wedged cleanup can delay exit but never hang the process."""
+
+    def __init__(self, state: ServerState, index: int, host: str,
+                 port: int) -> None:
+        super().__init__(name=f"tpuserve-ingest-{index}", daemon=True)
+        self.state = state
+        self.index = index
+        self.host = host
+        self.port = port
+        self.error: BaseException | None = None
+        self._ready = threading.Event()
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._stop_ev: asyncio.Event | None = None
+
+    def run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        try:
+            loop.run_until_complete(self._serve())
+        except BaseException as e:  # noqa: BLE001 — surfaced via wait_ready
+            self.error = e
+            log.exception("ingest loop %d failed", self.index)
+        finally:
+            self._ready.set()
+            loop.close()
+
+    async def _serve(self) -> None:
+        # The witness instruments this loop too: a threading lock held
+        # across an await on an ingest loop is just as much a bug here.
+        witness.maybe_install()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            sock.bind((self.host, self.port))
+        except OSError:
+            sock.close()
+            raise
+        app = make_app(self.state, loop_index=self.index, primary=False)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.SockSite(runner, sock)
+        await site.start()
+        self._stop_ev = asyncio.Event()
+        self._ready.set()
+        try:
+            await self._stop_ev.wait()
+        finally:
+            await runner.cleanup()
+
+    def wait_ready(self, timeout: float = 30.0) -> None:
+        """Block (call from an executor) until the listener is up; re-raise
+        a bind/startup failure in the caller."""
+        self._ready.wait(timeout)
+        if self.error is not None:
+            raise self.error
+
+    def request_stop(self) -> None:
+        """Thread-safe: ask the loop to tear its listener down and exit."""
+        loop, ev = self._loop, self._stop_ev
+        if loop is not None and ev is not None:
+            loop.call_soon_threadsafe(ev.set)
+
+
+def start_ingest_loops(state: ServerState, host: str,
+                       port: int) -> list[IngestLoop]:
+    """Spawn the N-1 extra accept loops for ``cfg.ingest_loops = N``.
+
+    Returns the (possibly empty) thread list; the caller must
+    ``await stop_ingest_loops`` on shutdown. Degrades to zero extra loops
+    with a warning where SO_REUSEPORT is unavailable — correctness never
+    depends on the parallel listeners, only ingest throughput does."""
+    n = max(1, state.cfg.ingest_loops)
+    if n <= 1:
+        return []
+    if not hasattr(socket, "SO_REUSEPORT"):
+        log.warning("ingest_loops = %d requested but SO_REUSEPORT is not "
+                    "available on this platform; serving on one loop", n)
+        return []
+    threads = [IngestLoop(state, i, host, port) for i in range(1, n)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+async def stop_ingest_loops(threads: list[IngestLoop]) -> None:
+    """Stop + join ingest loops without blocking the calling loop."""
+    loop = asyncio.get_running_loop()
+    for t in threads:
+        t.request_stop()
+    for t in threads:
+        await loop.run_in_executor(None, functools.partial(t.join, 10.0))
 
 
 class JsonLogFormatter(logging.Formatter):
@@ -1040,7 +1338,8 @@ def configure_logging(cfg: ServerConfig) -> None:
 
 
 async def serve_async(state: ServerState,
-                      ready: asyncio.Event | None = None) -> None:
+                      ready: asyncio.Event | None = None,
+                      stop: asyncio.Event | None = None) -> None:
     """Serve until SIGTERM/SIGINT, then drain gracefully.
 
     Rolling restarts drop zero accepted requests: on signal the server (1)
@@ -1049,19 +1348,35 @@ async def serve_async(state: ServerState,
     accepted request within ``drain_timeout_s``; (3) only then tears the
     batchers/pools down (runner cleanup -> state.stop()).
 
-    ``ready`` (tests) is set once the listener is up and signal handlers are
-    installed; the bound addresses land in ``state.serving_addresses``."""
+    With ``cfg.ingest_loops = N > 1`` the main loop's listener binds with
+    SO_REUSEPORT and N-1 dedicated ingest loops (IngestLoop threads) bind
+    sibling listeners on the same port: the kernel spreads connections, so
+    one asyncio accept/read loop is no longer the ingest choke point
+    (docs/PERFORMANCE.md "The ingest fast path").
+
+    ``ready`` (tests) is set once every listener is up and signal handlers
+    are installed; the bound addresses land in ``state.serving_addresses``.
+    ``stop`` (tests) substitutes for the signal-driven shutdown event."""
     cfg = state.cfg
     app = make_app(state)
     runner = web.AppRunner(app, access_log=None)
     await runner.setup()
-    site = web.TCPSite(runner, cfg.host, cfg.port)
+    reuse = cfg.ingest_loops > 1 and hasattr(socket, "SO_REUSEPORT")
+    site = web.TCPSite(runner, cfg.host, cfg.port, reuse_port=reuse or None)
     await site.start()
     state.serving_addresses = list(runner.addresses)
-    log.info("serving on %s", state.serving_addresses)
-
-    stop = asyncio.Event()
+    # Parallel ingest loops bind the ACTUAL port (cfg.port may be 0 =
+    # ephemeral; every SO_REUSEPORT sibling must name the bound one).
+    port = cfg.port or state.serving_addresses[0][1]
+    ingest_threads = start_ingest_loops(state, cfg.host, port)
     loop = asyncio.get_running_loop()
+    for t in ingest_threads:
+        await loop.run_in_executor(None, t.wait_ready)
+    log.info("serving on %s (%d accept loop(s))", state.serving_addresses,
+             1 + len(ingest_threads))
+
+    if stop is None:
+        stop = asyncio.Event()
     installed: list[signal.Signals] = []
     for sig in (signal.SIGTERM, signal.SIGINT):
         try:
@@ -1080,6 +1395,9 @@ async def serve_async(state: ServerState,
     finally:
         for sig in installed:
             loop.remove_signal_handler(sig)
+        # Ingest listeners go first: no accept loop may outlive the state
+        # teardown below (their handlers hop onto this loop's structures).
+        await stop_ingest_loops(ingest_threads)
         await runner.cleanup()  # on_cleanup -> state.stop()
 
 
