@@ -41,6 +41,9 @@ scripts/ingest_smoke.sh
 echo "== multichip smoke (8 replicas all serving / sharded mesh / reload mid-load) =="
 scripts/multichip_smoke.sh
 
+echo "== trace smoke (X-Trace-Id everywhere, stitched slow trace across the router->worker hop, exemplars, compile delta 0) =="
+scripts/trace_smoke.sh
+
 echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
 scripts/worker_drill.sh
 

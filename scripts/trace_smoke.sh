@@ -1,0 +1,230 @@
+#!/usr/bin/env bash
+# Trace smoke (ISSUE 12): a REAL router + 2 worker-process fleet under
+# closed-loop load with one injected worker_slow fault (400 ms, once per
+# worker), gating the end-to-end tracing contract (docs/OBSERVABILITY.md):
+#   1. EVERY response carries a well-formed X-Trace-Id — and an error
+#      response repeats it as trace_id in the JSON body;
+#   2. the slow request appears in the router's /debug/slow with its
+#      trace id, and /debug/trace?trace_id= returns a STITCHED span tree
+#      crossing the router→worker hop: one trace id end-to-end, router
+#      spans on pid 0 (request + attempt), the worker's full serving tree
+#      (request/body_read/parse/queue/compute) on its own pid lane;
+#   3. /metrics exemplar lines parse (OpenMetrics exemplar syntax with a
+#      32-hex trace id) on both the router and a worker;
+#   4. runtime_compiles_total delta is exactly 0 across the traced window
+#      (tracing introduces no new specializations).
+# Witnessed (TPUSERVE_LOCK_WITNESS=1): recorder + exemplar locks are hit
+# from every accept loop, so the run doubles as a race-detection pass.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export TPUSERVE_LOCK_WITNESS=1
+
+PORT=18473
+TMPD="$(mktemp -d /tmp/trace_smoke_XXXX)"
+CFG="$TMPD/cfg.toml"
+cat > "$CFG" <<EOF
+host = "127.0.0.1"
+port = $PORT
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+ingest_loops = 2
+
+[trace]
+slow_n = 8
+error_capacity = 64
+
+[router]
+enabled = true
+workers = 2
+retry_max = 2
+health_interval_s = 0.2
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+
+[faults]
+enabled = true
+seed = 3
+
+[[faults.rule]]
+kind = "worker_slow"
+model = "toy"
+probability = 1.0
+count = 1
+delay_ms = 400.0
+EOF
+
+python -m tpuserve serve --config "$CFG" &
+SERVER_PID=$!
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; rm -rf "$TMPD"' EXIT
+
+for _ in $(seq 1 120); do
+  if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then break; fi
+  sleep 0.5
+done
+curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null
+
+# Pre-load scrape of both workers: the compile-delta window opens AFTER
+# startup compiles (the injected slow requests and all traced load must
+# recompile nothing).
+curl -fsS "http://127.0.0.1:$PORT/workers/0/metrics" > "$TMPD/w0_before.txt"
+curl -fsS "http://127.0.0.1:$PORT/workers/1/metrics" > "$TMPD/w1_before.txt"
+
+# Closed-loop load through the router (the worker_slow rules fire on the
+# first request each worker serves — those become the recorded slow tail).
+python -m tpuserve bench --url "http://127.0.0.1:$PORT" \
+  --model toy --verb classify --duration 4 --warmup 1 --concurrency 8 \
+  --distinct 8 --edge 8 > "$TMPD/load.json"
+echo "load: $(cat "$TMPD/load.json")"
+
+curl -fsS "http://127.0.0.1:$PORT/workers/0/metrics" > "$TMPD/w0_after.txt"
+curl -fsS "http://127.0.0.1:$PORT/workers/1/metrics" > "$TMPD/w1_after.txt"
+curl -fsS "http://127.0.0.1:$PORT/metrics" > "$TMPD/router_metrics.txt"
+
+python - "$TMPD" "http://127.0.0.1:$PORT" <<'EOF'
+import json
+import re
+import sys
+import urllib.request
+
+tmpd, base = sys.argv[1], sys.argv[2]
+TID_RE = re.compile(r"^[0-9a-f]{32}$")
+
+
+def get(path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def post(path, body, ctype="application/x-npy"):
+    req = urllib.request.Request(base + path, data=body,
+                                 headers={"Content-Type": ctype})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def npy(seed):
+    import io
+
+    import numpy as np
+
+    buf = io.BytesIO()
+    np.save(buf, np.random.default_rng(seed).integers(
+        0, 255, (8, 8, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+with open(f"{tmpd}/load.json", encoding="utf-8") as f:
+    load = json.load(f)
+assert load["n_ok"] > 0 and load["n_err"] == 0, load
+
+# 1. Every response carries a well-formed X-Trace-Id — all distinct.
+seen = set()
+for i in range(20):
+    status, headers, _ = post("/v1/models/toy:classify", npy(100 + i))
+    assert status == 200, status
+    tid = headers.get("X-Trace-Id", "")
+    assert TID_RE.match(tid), f"bad/missing X-Trace-Id: {tid!r}"
+    seen.add(tid)
+assert len(seen) == 20, "trace ids must be unique per request"
+
+# ...including error responses, which repeat it in the JSON body.
+status, headers, body = post("/v1/models/toy:classify", b"garbage")
+assert status == 400, (status, body)
+err = json.loads(body)
+assert err.get("trace_id") == headers.get("X-Trace-Id"), err
+assert TID_RE.match(err["trace_id"]), err
+
+# 2. The injected-slow request is in /debug/slow; its stitched trace
+# crosses the router→worker hop with ONE id end-to-end.
+_, _, raw = get("/debug/slow")
+dump = json.loads(raw)
+slow = dump["slow"].get("toy", [])
+assert slow, "empty slow reservoir after loaded run"
+rec = max(slow, key=lambda r: r["duration_ms"])
+assert rec["duration_ms"] >= 300.0, \
+    f"worker_slow (400 ms) not the recorded tail: {rec['duration_ms']} ms"
+tid = rec["trace_id"]
+assert TID_RE.match(tid)
+
+status, _, raw = get(f"/debug/trace?trace_id={tid}")
+assert status == 200
+events = json.loads(raw)["traceEvents"]
+assert events and all(e["args"]["trace_id"] == tid for e in events), \
+    "stitched trace must carry one trace id end-to-end"
+by_pid = {}
+for e in events:
+    by_pid.setdefault(e["pid"], set()).add(e["name"])
+assert {"request", "attempt"} <= by_pid.get(0, set()), by_pid
+worker_pids = sorted(p for p in by_pid if p >= 1)
+assert worker_pids, f"no worker-side spans stitched in: {by_pid}"
+worker_names = set().union(*(by_pid[p] for p in worker_pids))
+assert {"request", "body_read", "parse", "queue", "compute"} <= worker_names, \
+    worker_names
+# The hop is visible: the worker's request span starts inside the
+# router's attempt span.
+attempt_ts = min(e["ts"] for e in events
+                 if e["pid"] == 0 and e["name"] == "attempt")
+worker_ts = min(e["ts"] for e in events
+                if e["pid"] >= 1 and e["name"] == "request")
+assert worker_ts >= attempt_ts, (attempt_ts, worker_ts)
+
+# 3. Exemplar lines parse on the router AND a worker.
+EX_RE = re.compile(
+    r'_bucket\{.*le="[^"]+"\} \d+ '
+    r'# \{trace_id="[0-9a-f]{32}"\} [0-9.e+-]+ \d+\.\d+$')
+for page in ("router_metrics.txt", "w0_after.txt"):
+    with open(f"{tmpd}/{page}", encoding="utf-8") as f:
+        lines = [ln for ln in f.read().splitlines() if "# {trace_id=" in ln]
+    assert lines, f"no exemplar lines in {page}"
+    bad = [ln for ln in lines if not EX_RE.search(ln)]
+    assert not bad, f"unparseable exemplar lines in {page}: {bad[:3]}"
+
+# 4. Compile delta 0 across the traced window, on every worker.
+def scrape(path):
+    out = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("#") or " " not in line:
+                continue
+            if " # {" in line:  # strip exemplar suffix before parsing
+                line = line.split(" # {", 1)[0]
+            k, v = line.rsplit(" ", 1)
+            try:
+                out[k] = float(v)
+            except ValueError:
+                pass
+    return out
+
+
+key = 'runtime_compiles_total{model="toy"}'
+for w in (0, 1):
+    before = scrape(f"{tmpd}/w{w}_before.txt")
+    after = scrape(f"{tmpd}/w{w}_after.txt")
+    assert before.get(key, 0) > 0, f"worker {w}: no startup compiles?"
+    delta = after.get(key, 0) - before.get(key, 0)
+    assert delta == 0, f"worker {w}: traced load recompiled (delta={delta})"
+
+print(f"trace smoke OK: {load['throughput_per_s']:.1f} req/s, "
+      f"slow trace {tid[:8]}… stitched across pids {[0] + worker_pids} "
+      f"({rec['duration_ms']:.0f} ms), exemplars parse, compile delta 0")
+EOF
+
+kill -TERM $SERVER_PID
+wait $SERVER_PID 2>/dev/null || true
+trap 'rm -rf "$TMPD"' EXIT
+echo "trace smoke OK"

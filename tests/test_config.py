@@ -333,3 +333,38 @@ family = "resnet50"
         RouterConfig(unhealthy_after=0)
     with pytest.raises(ValueError, match="port_base"):
         WorkerConfig(port_base=-1)
+
+
+def test_trace_block(tmp_path):
+    p = tmp_path / "trace.toml"
+    p.write_text(
+        """
+[trace]
+slow_n = 4
+error_capacity = 32
+always_record_errors = false
+exemplars = false
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.trace.slow_n == 4
+    assert cfg.trace.error_capacity == 32
+    assert cfg.trace.always_record_errors is False
+    assert cfg.trace.exemplars is False
+    # Defaults + dot-path override.
+    cfg2 = load_config(None, overrides=["trace.slow_n=9"])
+    assert cfg2.trace.slow_n == 9
+    assert cfg2.trace.exemplars is True
+
+
+def test_trace_block_validation():
+    from tpuserve.config import TraceConfig
+
+    with pytest.raises(ValueError, match="slow_n"):
+        TraceConfig(slow_n=-1)
+    with pytest.raises(ValueError, match="error_capacity"):
+        TraceConfig(error_capacity=-1)

@@ -70,6 +70,57 @@ def test_tracer_chrome_format():
     assert ev["args"]["batch"] == 8
 
 
+def test_observe_bisect_matches_linear_scan():
+    """ISSUE 12 satellite: bucket assignment via bisect_left must be
+    bit-identical to the old linear scan (first bound with value <= b,
+    overflow past the last) for every boundary case."""
+    h = Histogram("lat")
+    bounds = h.bounds
+
+    def linear_bucket(value):
+        for i, b in enumerate(bounds):
+            if value <= b:
+                return i
+        return len(bounds)
+
+    probes = [0.0, -1.0, -0.001, 0.05, 0.1, 0.100001, 1e5, 1e5 + 1, 1e9,
+              float("inf")]
+    probes += list(bounds)                      # exact bounds land IN bucket
+    probes += [b * 1.0000001 for b in bounds]   # just past -> next bucket
+    probes += [b * 0.9999999 for b in bounds]
+    for v in probes:
+        h2 = Histogram("probe")
+        h2.observe(v)
+        assert h2.counts[linear_bucket(v)] == 1, \
+            f"value {v}: bisect bucket != linear bucket {linear_bucket(v)}"
+
+
+def test_histogram_exemplars_rendered():
+    """[trace] exemplars: the last trace id observed in a bucket renders in
+    OpenMetrics exemplar syntax on that bucket's /metrics line."""
+    m = Metrics()
+    tid = "ab" * 16
+    m.histogram("latency_ms{model=t,phase=total}").observe(12.0, trace_id=tid)
+    m.histogram("latency_ms{model=t,phase=total}").observe(13.0)  # untraced
+    text = m.render_prometheus()
+    ex_lines = [ln for ln in text.splitlines() if "# {trace_id=" in ln]
+    assert len(ex_lines) == 1
+    assert f'# {{trace_id="{tid}"}} 12 ' in ex_lines[0]
+    assert ex_lines[0].startswith("latency_ms_bucket{")
+    # A later traced observation in the same bucket overwrites the slot.
+    m.histogram("latency_ms{model=t,phase=total}").observe(12.5,
+                                                           trace_id="cd" * 16)
+    assert 'trace_id="cdcdcdcdcdcdcdcdcdcdcdcdcdcdcdcd"' \
+        in m.render_prometheus()
+
+
+def test_histogram_exemplars_disabled():
+    m = Metrics(exemplars=False)
+    m.histogram("latency_ms{model=t,phase=total}").observe(12.0,
+                                                           trace_id="ab" * 16)
+    assert "# {trace_id=" not in m.render_prometheus()
+
+
 def test_percentile_exact():
     assert percentile([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], 0.5) == 5
     assert percentile([], 0.5) == 0.0

@@ -687,3 +687,88 @@ def test_worker_crash_degrades_then_respawns(loop):
                 await runner.cleanup()
 
     loop.run_until_complete(go())
+
+
+def test_trace_propagates_across_router_worker_hop(fleet):
+    """ISSUE 12: one trace id end-to-end — the response header, the router
+    /debug/slow reservoir, and a stitched /debug/trace whose span tree
+    crosses the process boundary (router spans on pid 0, worker spans on
+    pid = worker id + 1, the worker's root parented under the router's
+    attempt span)."""
+    import json
+
+    run, session, base, state = fleet
+
+    async def go():
+        # toylag's worker_slow fault (300 ms) makes this the slowest toylag
+        # request by far — guaranteed into both recorders' slow reservoirs.
+        status, body, headers = await _post(session, base, "toylag", npy(91))
+        assert status == 200, body
+        tid = headers["X-Trace-Id"]
+        assert len(tid) == 32 and int(tid, 16) >= 0
+
+        async with session.get(f"{base}/debug/slow") as r:
+            assert r.status == 200
+            dump = await r.json()
+        lag_ids = {rec["trace_id"] for rec in dump["slow"].get("toylag", [])}
+        assert tid in lag_ids, sorted(dump["slow"])
+
+        async with session.get(f"{base}/debug/trace?trace_id={tid}") as r:
+            assert r.status == 200
+            data = json.loads(await r.text())
+        evs = data["traceEvents"]
+        assert evs and all(e["args"]["trace_id"] == tid for e in evs)
+        by_pid: dict = {}
+        for e in evs:
+            by_pid.setdefault(e["pid"], set()).add(e["name"])
+        # Router lane: the root request span + at least one relay attempt.
+        assert {"request", "attempt"} <= by_pid[0], by_pid
+        # Worker lane(s): the full single-process serving tree.
+        worker_pids = [p for p in by_pid if p >= 1]
+        assert worker_pids, by_pid
+        worker_names = set().union(*(by_pid[p] for p in worker_pids))
+        assert {"request", "body_read", "queue", "compute"} <= worker_names
+
+        # Raw record form: the worker's root span parents under the
+        # router's attempt span (the X-Parent-Span relay).
+        async with session.get(
+                f"{base}/debug/trace?trace_id={tid}&format=record") as r:
+            rec = await r.json()
+        spans = rec["spans"]
+        attempts = {s["span_id"] for s in spans if s["name"] == "attempt"}
+        worker_roots = [s for s in spans
+                        if s["name"] == "request" and s["pid"] >= 1]
+        assert worker_roots
+        assert all(s["parent_id"] in attempts for s in worker_roots)
+        assert "router" in rec["sources"] and len(rec["sources"]) >= 2
+
+    run(go())
+
+
+def test_router_error_bodies_carry_trace_id(fleet):
+    """Error paths across the tier: a router-side 404 and a worker-side
+    504 both answer with trace_id in the JSON body matching X-Trace-Id —
+    and the relayed 504's id is the ONE id the router stamped (the worker
+    adopted it, never minted its own)."""
+    import json
+
+    run, session, base, state = fleet
+
+    async def go():
+        status, body, headers = await _post(session, base, "ghost", npy(1))
+        assert status == 404
+        js = json.loads(body)
+        assert js["trace_id"] == headers["X-Trace-Id"]
+
+        # slow_compute (600 ms) vs a 250 ms deadline: 504s inside the
+        # worker; the body the client sees was built by the WORKER with
+        # the router-minted trace id.
+        status, body, headers = await _post(session, base, "toyslow",
+                                            npy(92), timeout_ms=250)
+        assert status == 504, body
+        js = json.loads(body)
+        assert js.get("trace_id") == headers["X-Trace-Id"], js
+        # Errored request retained by the router's flight recorder.
+        assert state.recorder.get(headers["X-Trace-Id"]) is not None
+
+    run(go())

@@ -1,0 +1,485 @@
+"""End-to-end request tracing (ISSUE 12; docs/OBSERVABILITY.md).
+
+Covers the trace-context contract (mint/adopt, X-Trace-Id on EVERY
+response, trace_id in error bodies), the flight recorder's bounds (ring
+overflow keeps newest, slowest-N reservoir evicts the fastest under churn,
+errored requests retained even when fast), chrome_trace JSON validity with
+the documented event fields, single-flight trace links, and /metrics
+exemplars — over real HTTP where the contract is user-facing.
+"""
+
+import asyncio
+import io
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpuserve.cache import ModelCache
+from tpuserve.config import CacheConfig, ModelConfig, ServerConfig, TraceConfig
+from tpuserve.obs import (FlightRecorder, Metrics, TraceContext, Tracer,
+                          spans_to_chrome, valid_trace_id)
+from tpuserve.server import ServerState, make_app
+
+# ---------------------------------------------------------------------------
+# TraceContext
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_mints_valid_ids():
+    a, b = TraceContext(), TraceContext()
+    assert valid_trace_id(a.trace_id) and valid_trace_id(b.trace_id)
+    assert a.trace_id != b.trace_id  # 128-bit mint: no collisions in two
+    assert len(a.root_id) == 16
+
+
+def test_trace_context_adopts_wellformed_header_only():
+    tid = "ab" * 16
+    ctx = TraceContext.from_headers({"X-Trace-Id": tid,
+                                     "X-Parent-Span": "cd" * 8})
+    assert ctx.trace_id == tid
+    assert ctx.parent_id == "cd" * 8
+    for junk in ("short", "Z" * 32, "AB" * 16, "ab" * 17, "", None, 42):
+        bad = TraceContext(trace_id=junk)
+        assert bad.trace_id != junk
+        assert valid_trace_id(bad.trace_id)  # replaced, never echoed
+
+
+def test_span_records_documented_fields():
+    ctx = TraceContext(pid=3)
+    sid = ctx.span("queue", 100.0, 100.25, tid="toy", batch=7)
+    ctx.root_span("request", 99.0, 101.0, tid="toy", status=200)
+    (queue, root) = ctx.spans
+    for s in ctx.spans:
+        assert set(s) == {"name", "trace_id", "span_id", "parent_id",
+                          "ts_us", "dur_us", "tid", "pid", "args"}
+        assert s["trace_id"] == ctx.trace_id
+        assert s["pid"] == 3
+    assert queue["span_id"] == sid
+    assert queue["parent_id"] == ctx.root_id  # default parent = root
+    assert queue["args"]["batch"] == 7
+    assert root["span_id"] == ctx.root_id
+    assert root["parent_id"] is None  # no upstream attempt relayed us
+    assert abs(queue["dur_us"] - 250_000) < 1
+
+
+def test_root_span_parents_under_relayed_attempt():
+    parent = "ef" * 8
+    ctx = TraceContext.from_headers({"X-Trace-Id": "12" * 16,
+                                     "X-Parent-Span": parent})
+    ctx.root_span("request", 0.0, 1.0, tid="toy")
+    assert ctx.spans[0]["parent_id"] == parent
+
+
+# ---------------------------------------------------------------------------
+# Tracer ring bounds + chrome output
+# ---------------------------------------------------------------------------
+
+
+def test_ring_overflow_keeps_newest():
+    t = Tracer(capacity=8)
+    for i in range(50):
+        t.add(f"e{i}", float(i), float(i) + 0.1, tid="m")
+    names = [e["name"] for e in json.loads(t.chrome_trace())["traceEvents"]]
+    assert names == [f"e{i}" for i in range(42, 50)]
+
+
+def test_chrome_trace_limit_and_since_us():
+    t = Tracer(capacity=64)
+    for i in range(20):
+        t.add(f"e{i}", float(i), float(i) + 0.1)
+    evs = json.loads(t.chrome_trace(limit=3))["traceEvents"]
+    assert [e["name"] for e in evs] == ["e17", "e18", "e19"]  # newest
+    evs = json.loads(t.chrome_trace(since_us=15e6))["traceEvents"]
+    assert [e["name"] for e in evs] == [f"e{i}" for i in range(15, 20)]
+    assert json.loads(t.chrome_trace(limit=0))["traceEvents"] == []
+
+
+def test_chrome_trace_event_fields_valid_json():
+    t = Tracer()
+    t.add("batch[(2, 8)]", 100.0, 100.5, tid="toy", trace_id="ab" * 16,
+          pid=2, n=2, trace_ids=["ab" * 16])
+    data = json.loads(t.chrome_trace())
+    (ev,) = data["traceEvents"]
+    assert set(ev) == {"name", "ph", "ts", "dur", "pid", "tid", "args"}
+    assert ev["ph"] == "X" and ev["pid"] == 2 and ev["tid"] == "toy"
+    assert ev["args"]["trace_id"] == "ab" * 16
+    assert ev["args"]["trace_ids"] == ["ab" * 16]
+
+
+def test_spans_to_chrome_documented_fields():
+    ctx = TraceContext(pid=1)
+    ctx.span("compute", 100.2, 100.4, tid="toy", batch=3)
+    ctx.root_span("request", 100.0, 100.5, tid="toy", status=200)
+    data = json.loads(spans_to_chrome(ctx.spans))
+    evs = data["traceEvents"]
+    assert [e["name"] for e in evs] == ["request", "compute"]  # ts-sorted
+    for e in evs:
+        assert set(e) == {"name", "ph", "ts", "dur", "pid", "tid", "args"}
+        assert e["ph"] == "X" and e["pid"] == 1
+        assert e["args"]["trace_id"] == ctx.trace_id
+        assert "span_id" in e["args"] and "parent_id" in e["args"]
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder bounds
+# ---------------------------------------------------------------------------
+
+
+def _ctx_with_span(dur_ms: float = 1.0) -> TraceContext:
+    ctx = TraceContext()
+    ctx.root_span("request", 100.0, 100.0 + dur_ms / 1e3, tid="toy")
+    return ctx
+
+
+def test_slow_reservoir_keeps_slowest_under_churn():
+    fr = FlightRecorder(slow_n=4, error_capacity=8)
+    rng = np.random.default_rng(0)
+    durations = list(rng.permutation(50).astype(float) + 1.0)
+    ids = {}
+    for d in durations:
+        ctx = _ctx_with_span(d)
+        ids[d] = ctx.trace_id
+        fr.finish(ctx, "toy", 200, d)
+    dump = fr.dump()
+    kept = [r["duration_ms"] for r in dump["slow"]["toy"]]
+    assert kept == sorted(durations, reverse=True)[:4]  # slowest-first
+    # Retained records resolve by id; evicted (fast) ones are gone.
+    assert fr.get(ids[max(durations)]) is not None
+    assert fr.get(ids[min(durations)]) is None
+    assert fr.stats()["slow"]["toy"] == 4
+
+
+def test_slow_reservoirs_are_per_model():
+    fr = FlightRecorder(slow_n=2, error_capacity=0,
+                        always_record_errors=False)
+    for model in ("a", "b"):
+        for d in (5.0, 10.0, 1.0):
+            fr.finish(_ctx_with_span(d), model, 200, d)
+    dump = fr.dump()
+    assert [r["duration_ms"] for r in dump["slow"]["a"]] == [10.0, 5.0]
+    assert [r["duration_ms"] for r in dump["slow"]["b"]] == [10.0, 5.0]
+    assert fr.dump(model="a")["slow"].keys() == {"a"}
+
+
+def test_errored_requests_retained_even_when_fast():
+    fr = FlightRecorder(slow_n=2, error_capacity=8)
+    # Fill the slow reservoir with slow successes...
+    for d in (500.0, 400.0):
+        fr.finish(_ctx_with_span(d), "toy", 200, d)
+    # ...then a FAST shed: far too quick for the slow reservoir, but
+    # errors record unconditionally.
+    ctx = _ctx_with_span(0.2)
+    fr.finish(ctx, "toy", 503, 0.2)
+    assert fr.get(ctx.trace_id) is not None
+    dump = fr.dump()
+    assert [r["status"] for r in dump["errors"]] == [503]
+    assert all(r["status"] == 200 for r in dump["slow"]["toy"])
+
+
+def test_error_fifo_bounded_newest_kept():
+    fr = FlightRecorder(slow_n=0, error_capacity=3)
+    ids = []
+    for i in range(7):
+        ctx = _ctx_with_span(1.0)
+        ids.append(ctx.trace_id)
+        fr.finish(ctx, "toy", 500, 1.0)
+    dump = fr.dump()
+    assert [r["trace_id"] for r in dump["errors"]] == ids[-1:-4:-1]
+    assert fr.get(ids[0]) is None  # evicted from the FIFO
+    assert fr.get(ids[-1]) is not None
+
+
+def test_record_in_both_reservoirs_survives_single_eviction():
+    """A slow ERROR sits in both reservoirs; falling out of one must not
+    drop it from /debug/trace while the other still holds it."""
+    fr = FlightRecorder(slow_n=2, error_capacity=16)
+    slow_err = _ctx_with_span(900.0)
+    fr.finish(slow_err, "toy", 504, 900.0)
+    # Push it out of the slow heap with two even slower successes.
+    for d in (1000.0, 1100.0):
+        fr.finish(_ctx_with_span(d), "toy", 200, d)
+    rec = fr.get(slow_err.trace_id)
+    assert rec is not None and rec["status"] == 504  # error FIFO holds it
+    assert all(r["status"] == 200
+               for r in fr.dump()["slow"]["toy"])
+
+
+def test_always_record_errors_off():
+    fr = FlightRecorder(slow_n=0, error_capacity=8,
+                        always_record_errors=False)
+    ctx = _ctx_with_span(1.0)
+    assert not fr.finish(ctx, "toy", 500, 1.0)
+    assert fr.get(ctx.trace_id) is None
+
+
+def test_recorder_dump_and_records_are_json_clean():
+    fr = FlightRecorder(slow_n=2, error_capacity=2)
+    ctx = _ctx_with_span(5.0)
+    fr.finish(ctx, "toy", 200, 5.0)
+    dump = json.loads(json.dumps(fr.dump()))  # must round-trip
+    rec = dump["slow"]["toy"][0]
+    assert set(rec) == {"trace_id", "model", "status", "duration_ms", "ts",
+                        "spans"}  # no private retention flags leak
+    assert rec["spans"][0]["name"] == "request"
+
+
+def test_recorder_ticks_trace_recorded_counters():
+    m = Metrics()
+    fr = FlightRecorder(slow_n=2, error_capacity=2, metrics=m)
+    fr.finish(_ctx_with_span(5.0), "toy", 200, 5.0)
+    fr.finish(_ctx_with_span(1.0), "toy", 503, 1.0)
+    assert m.counter('trace_recorded_total{model=toy,kind=slow}').value == 2
+    assert m.counter('trace_recorded_total{model=toy,kind=error}').value == 1
+
+
+# ---------------------------------------------------------------------------
+# Single-flight trace links (tpuserve.cache)
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_waiter_links_leader_trace():
+    async def go():
+        m = Metrics()
+        cache = ModelCache("toy", CacheConfig(enabled=True), m,
+                           version_fn=lambda: 1)
+        loop = asyncio.get_running_loop()
+        base: asyncio.Future = loop.create_future()
+        leader, waiter = TraceContext(), TraceContext()
+        w1 = cache.submit_through("k", lambda: base, ctx=leader)
+        w2 = cache.submit_through("k", lambda: 1 / 0, ctx=waiter)
+        link = [s for s in waiter.spans if s["name"] == "coalesced"]
+        assert len(link) == 1
+        assert link[0]["args"]["linked_trace"] == leader.trace_id
+        assert not leader.spans  # the leader records nothing extra
+        base.set_result({"ok": 1})
+        assert await w1 == {"ok": 1} and await w2 == {"ok": 1}
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+# ---------------------------------------------------------------------------
+# Over HTTP: the user-facing contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def client(loop):
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=5.0, dtype="float32", num_classes=10,
+                            parallelism="single", request_timeout_ms=10_000.0,
+                            wire_size=8)],
+        decode_threads=2,
+        trace=TraceConfig(slow_n=8, error_capacity=32),
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def setup():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    c = loop.run_until_complete(setup())
+    yield lambda coro: loop.run_until_complete(coro), c, state
+    loop.run_until_complete(c.close())
+
+
+def npy_bytes(seed: int = 0) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.random.default_rng(seed).integers(
+        0, 255, (8, 8, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+NPY = "application/x-npy"
+
+
+def test_every_response_carries_trace_id(client):
+    run, c, state = client
+
+    async def go():
+        seen = set()
+        # success, unknown model (404), malformed body (400)
+        for path, data, ctype in (
+                ("/v1/models/toy:predict", npy_bytes(), NPY),
+                ("/v1/models/ghost:predict", npy_bytes(), NPY),
+                ("/v1/models/toy:predict", b"garbage", NPY)):
+            resp = await c.post(path, data=data,
+                                headers={"Content-Type": ctype})
+            tid = resp.headers.get("X-Trace-Id")
+            assert valid_trace_id(tid), (path, resp.status, tid)
+            seen.add(tid)
+        assert len(seen) == 3  # every request gets its own id
+
+    run(go())
+
+
+def test_client_supplied_trace_id_adopted(client):
+    run, c, state = client
+    tid = "5a" * 16
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=npy_bytes(),
+                            headers={"Content-Type": NPY, "X-Trace-Id": tid})
+        assert resp.status == 200
+        assert resp.headers["X-Trace-Id"] == tid
+        # Malformed client ids are REPLACED, not echoed.
+        resp = await c.post("/v1/models/toy:predict", data=npy_bytes(),
+                            headers={"Content-Type": NPY,
+                                     "X-Trace-Id": "not-hex!"})
+        assert resp.status == 200
+        assert valid_trace_id(resp.headers["X-Trace-Id"])
+        assert resp.headers["X-Trace-Id"] != "not-hex!"
+
+    run(go())
+
+
+def test_error_bodies_carry_trace_id(client):
+    """ISSUE 12 satellite: 400/429/503/504 JSON bodies carry a trace_id
+    matching the X-Trace-Id header, so a shed/504'd user report joins
+    directly against the flight recorder."""
+    run, c, state = client
+
+    async def go():
+        statuses = {}
+
+        async def check(resp, want):
+            assert resp.status == want, await resp.text()
+            body = await resp.json()
+            assert valid_trace_id(body.get("trace_id")), (want, body)
+            assert body["trace_id"] == resp.headers["X-Trace-Id"]
+            statuses[want] = body["trace_id"]
+
+        # 400: undecodable body.
+        await check(await c.post("/v1/models/toy:predict", data=b"junk",
+                                 headers={"Content-Type": NPY}), 400)
+        # 429: queue full (force the shed check to fire).
+        b = state.batchers["toy"]
+        saved = b._pending
+        b._pending = b.cfg.max_queue
+        try:
+            await check(await c.post("/v1/models/toy:predict",
+                                     data=npy_bytes(),
+                                     headers={"Content-Type": NPY}), 429)
+        finally:
+            b._pending = saved
+        # 503: draining.
+        state.draining = True
+        try:
+            await check(await c.post("/v1/models/toy:predict",
+                                     data=npy_bytes(),
+                                     headers={"Content-Type": NPY}), 503)
+        finally:
+            state.draining = False
+        # 504: already-expired deadline.
+        await check(await c.post("/v1/models/toy:predict?timeout_ms=0.01",
+                                 data=npy_bytes(),
+                                 headers={"Content-Type": NPY}), 504)
+        # Every one of those landed in the flight recorder's error FIFO.
+        for want, tid in statuses.items():
+            rec = state.recorder.get(tid)
+            assert rec is not None and rec["status"] == want
+
+    run(go())
+
+
+def test_slow_dump_has_complete_span_tree(client):
+    run, c, state = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=npy_bytes(3),
+                            headers={"Content-Type": NPY})
+        assert resp.status == 200
+        tid = resp.headers["X-Trace-Id"]
+        async with c.get("/debug/slow") as r:
+            assert r.status == 200
+            dump = await r.json()
+        recs = {rec["trace_id"]: rec for rec in dump["slow"]["toy"]}
+        assert tid in recs
+        names = {s["name"] for s in recs[tid]["spans"]}
+        # The full serving path: HTTP ingest -> dispatch -> batcher phases.
+        assert {"request", "body_read", "parse", "dispatch", "queue",
+                "preproc", "h2d", "compute", "postproc"} <= names
+        spans = recs[tid]["spans"]
+        assert all(s["trace_id"] == tid for s in spans)
+        # Phase spans carry the batch id they rode in.
+        batch_ids = {s["args"]["batch"] for s in spans
+                     if s["name"] == "compute"}
+        assert len(batch_ids) == 1
+        # /stats exposes reservoir occupancy.
+        async with c.get("/stats") as r:
+            stats = await r.json()
+        assert stats["trace"]["slow"]["toy"] >= 1
+
+    run(go())
+
+
+def test_trace_endpoint_by_id_and_ring_limits(client):
+    run, c, state = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=npy_bytes(4),
+                            headers={"Content-Type": NPY})
+        tid = resp.headers["X-Trace-Id"]
+        # One recorded tree, Chrome format (valid JSON, documented fields).
+        async with c.get(f"/debug/trace?trace_id={tid}") as r:
+            assert r.status == 200
+            data = json.loads(await r.text())
+        assert {e["name"] for e in data["traceEvents"]} >= {"request",
+                                                            "compute"}
+        # Raw record form (what the router stitches).
+        async with c.get(f"/debug/trace?trace_id={tid}&format=record") as r:
+            rec = await r.json()
+        assert rec["trace_id"] == tid and rec["spans"]
+        # Unknown id -> 404, not an empty 200.
+        async with c.get(f"/debug/trace?trace_id={'0' * 32}") as r:
+            assert r.status == 404
+        # Ring dump honors ?limit= (satellite: default 5000, never the
+        # whole ring on a loaded server) and rejects junk.
+        async with c.get("/debug/trace?limit=2") as r:
+            ring = json.loads(await r.text())
+        assert len(ring["traceEvents"]) <= 2
+        async with c.get("/debug/trace?limit=nope") as r:
+            assert r.status == 400
+        async with c.get("/debug/trace?limit=-1") as r:
+            assert r.status == 400
+        async with c.get("/debug/trace?since_us=99999999999999999") as r:
+            assert json.loads(await r.text())["traceEvents"] == []
+
+    run(go())
+
+
+def test_metrics_exemplars_over_http(client):
+    run, c, state = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=npy_bytes(5),
+                            headers={"Content-Type": NPY})
+        tid = resp.headers["X-Trace-Id"]
+        async with c.get("/metrics") as r:
+            text = await r.text()
+        ex_lines = [ln for ln in text.splitlines() if "# {trace_id=" in ln]
+        assert ex_lines, "no exemplar lines on /metrics"
+        # Every exemplar is a well-formed trace id on a histogram bucket
+        # line; the latest request's id appears on its total-latency bucket.
+        import re
+
+        pat = re.compile(
+            r'_bucket\{.*le="[^"]+"\} \d+ '
+            r'# \{trace_id="([0-9a-f]{32})"\} [0-9.e+-]+ \d+\.\d+$')
+        assert all(pat.search(ln) for ln in ex_lines), ex_lines[:3]
+        assert any(tid in ln and "phase=\"total\"" in ln.replace('\\', '')
+                   for ln in ex_lines)
+
+    run(go())

@@ -106,6 +106,10 @@ class _Request:
     # Priority class ("interactive"/"batch"; obs.PRIORITIES) resolved at
     # admission from X-Priority or the model default; None = unscheduled.
     priority: str | None = None
+    # Request trace context (obs.TraceContext, ISSUE 12): the batcher
+    # appends per-request queue + phase spans (tagged with the batch id)
+    # to it; None when the caller doesn't trace (tests, embedding).
+    ctx: Any = None
 
 
 class ModelBatcher:
@@ -302,7 +306,8 @@ class ModelBatcher:
     # -- submission (event loop) --------------------------------------------
     def submit(self, item: Any, group: Hashable = None,
                deadline_at: float | None = None,
-               priority: str | None = None) -> asyncio.Future:
+               priority: str | None = None,
+               ctx: Any = None) -> asyncio.Future:
         """Enqueue one decoded request; returns a Future of its result.
 
         ``deadline_at`` (perf_counter clock) is the request's absolute
@@ -310,7 +315,9 @@ class ModelBatcher:
         future fails with DeadlineExceeded instead of dispatching.
         ``priority`` labels the request's queue-wait histogram (the fleet
         scheduler's arbitration happened BEFORE submit — by here the
-        request is admitted either way)."""
+        request is admitted either way). ``ctx`` (obs.TraceContext)
+        collects the request's queue/phase spans when the HTTP layer is
+        tracing it."""
         if not self._running or self._inflight is None:
             raise RuntimeError(f"batcher for {self.model.name} not started")
         if self._pending >= self.cfg.max_queue:
@@ -320,7 +327,7 @@ class ModelBatcher:
         fut: asyncio.Future = loop.create_future()
         req = _Request(item=item, group=group, future=fut,
                        enqueued_at=time.perf_counter(), deadline_at=deadline_at,
-                       priority=priority)
+                       priority=priority, ctx=ctx)
         q = self._queues.get(group)
         if q is None:
             q = self._queues[group] = asyncio.Queue()
@@ -333,7 +340,8 @@ class ModelBatcher:
 
     def submit_threadsafe(self, item: Any, group: Hashable = None,
                           deadline_at: float | None = None,
-                          priority: str | None = None) -> cf.Future:
+                          priority: str | None = None,
+                          ctx: Any = None) -> cf.Future:
         """Loop-safe submit for callers OFF the batcher's event loop — the
         parallel ingest loops (ISSUE 11; ``[server] ingest_loops``) and any
         embedding thread. Schedules the real ``submit`` on the owning loop
@@ -353,7 +361,7 @@ class ModelBatcher:
         def _do() -> None:
             try:
                 fut = self.submit(item, group=group, deadline_at=deadline_at,
-                                  priority=priority)
+                                  priority=priority, ctx=ctx)
             except Exception as e:  # QueueFull / stopped: through the future
                 out.set_exception(e)
                 return
@@ -597,11 +605,16 @@ class ModelBatcher:
                 self._maybe_idle()
                 continue
             now = time.perf_counter()
+            now_wall = time.time()
             for r in live:
                 wait_ms = (now - r.enqueued_at) * 1e3
-                self._h_phase["queue"].observe(wait_ms)
+                tid = r.ctx.trace_id if r.ctx is not None else None
+                self._h_phase["queue"].observe(wait_ms, trace_id=tid)
                 self._h_qwait[r.priority or self._default_priority].observe(
-                    wait_ms)
+                    wait_ms, trace_id=tid)
+                if r.ctx is not None:
+                    r.ctx.span("queue", now_wall - wait_ms / 1e3, now_wall,
+                               tid=self.model.name)
             task = asyncio.get_running_loop().create_task(self._dispatch(live, group))
             self._dispatch_tasks.add(task)
             task.add_done_callback(self._dispatch_tasks.discard)
@@ -705,9 +718,28 @@ class ModelBatcher:
         fill = len(reqs) / bucket[0]
         self._g_fill.set(fill)
         self._c_batches.inc()
+        # Batch identity for trace correlation (ISSUE 12): the lifetime
+        # batch counter read right after its tick — unique per model (all
+        # increments happen on the owning loop). The ring's batch span
+        # carries its member trace ids; each member's per-phase spans carry
+        # this id back, so a request tree and the batch timeline join both
+        # ways. Retries/splits re-enter here and get their own batch id —
+        # a retried request's tree visibly contains BOTH attempts.
+        bid = int(self._c_batches.value)
+        ctxs = [r.ctx for r in reqs if r.ctx is not None]
+        ex_tid = ctxs[0].trace_id if ctxs else None
 
         wall0 = time.time()
         t0 = time.perf_counter()
+
+        def mark(phase: str, t_a: float, t_b: float) -> None:
+            """Observe one batch phase (exemplar = a member trace id) and
+            append the span to every traced member, batch-tagged."""
+            self._h_phase[phase].observe((t_b - t_a) * 1e3, trace_id=ex_tid)
+            for c in ctxs:
+                c.span(phase, wall0 + (t_a - t0), wall0 + (t_b - t0),
+                       tid=name, batch=bid)
+
         items = [r.item for r in reqs]
         # Assemble stage: into a recycled arena buffer when provably
         # equivalent, else the model's allocating assemble.
@@ -721,7 +753,7 @@ class ModelBatcher:
                 host_batch = await self.stages.run(
                     name, "assemble", self.model.assemble, items, bucket)
             t1 = time.perf_counter()
-            self._h_phase["preproc"].observe((t1 - t0) * 1e3)
+            mark("preproc", t0, t1)
 
             if self.deferred:
                 # Deferred mode: enqueue is cheap (shm write + slot wait =
@@ -736,13 +768,13 @@ class ModelBatcher:
                     self.injector.check("batch_error", name)
                 out_fut = await self.runtime.enqueue(bucket, host_batch)
                 t2 = time.perf_counter()
-                self._h_phase["h2d"].observe((t2 - t1) * 1e3)
+                mark("h2d", t1, t2)
                 if not released[0]:
                     self._inflight.release()
                     released[0] = True
                 np_out = await out_fut
                 t3 = time.perf_counter()
-                self._h_phase["compute"].observe((t3 - t2) * 1e3)
+                mark("compute", t2, t3)
                 if self.device_time_cb is not None:
                     self.device_time_cb(t3 - t2)
             else:
@@ -764,7 +796,7 @@ class ModelBatcher:
                         name, "h2d", self.runtime.run, bucket, host_batch,
                         replica)
                     t2 = time.perf_counter()
-                    self._h_phase["h2d"].observe((t2 - t1) * 1e3)
+                    mark("h2d", t1, t2)
 
                     # fetch stage: "compute" = dispatch-to-ready wall time.
                     # With per-stage executors this is the device's own
@@ -774,7 +806,7 @@ class ModelBatcher:
                     np_out = await self.stages.run(
                         name, "fetch", self.runtime.fetch, outputs)
                     t3 = time.perf_counter()
-                    self._h_phase["compute"].observe((t3 - t2) * 1e3)
+                    mark("compute", t2, t3)
                     if self.device_time_cb is not None:
                         # Fleet device-time ledger: the device section
                         # (dispatch-to-ready) is what models compete for.
@@ -791,7 +823,7 @@ class ModelBatcher:
         results = await self.stages.run(
             name, "postproc", self.model.host_postprocess, np_out, len(reqs))
         t4 = time.perf_counter()
-        self._h_phase["postproc"].observe((t4 - t3) * 1e3)
+        mark("postproc", t3, t4)
         self._c_items.inc(len(reqs))
         # Feed the adaptive scheduler's per-bucket duration model (tracked
         # even with adaptive off: the gauge is useful on its own).
@@ -801,7 +833,11 @@ class ModelBatcher:
         # starts by the time spent between the two calls.
         self.metrics.tracer.add(
             f"batch[{bucket}]", wall0, wall0 + (t4 - t0),
-            tid=name, n=len(reqs), fill=fill,
+            tid=name, trace_id=ex_tid, n=len(reqs), fill=fill, batch=bid,
+            # Member trace ids, capped: joins the ring's batch timeline to
+            # the flight recorder's per-request trees without letting a
+            # 64-wide bucket bloat every ring event.
+            trace_ids=[c.trace_id for c in ctxs[:8]],
         )
         if self.breaker is not None:
             self.breaker.record_success()

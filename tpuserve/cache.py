@@ -97,6 +97,11 @@ class _Flight:
     key: str
     version: int
     waiters: list[asyncio.Future]
+    # The leader request's trace id (ISSUE 12): a coalesced waiter records
+    # a link span naming it, so a waiter's span tree explains WHERE its
+    # result was actually computed (the leader's trace has the batch
+    # phases; the waiter's has only the coalesced link + the wait).
+    leader_trace: "str | None" = None
 
 
 class ModelCache:
@@ -156,14 +161,18 @@ class ModelCache:
 
     # -- single-flight --------------------------------------------------------
     def submit_through(self, key: str,
-                       submit: Callable[[], asyncio.Future]) -> asyncio.Future:
+                       submit: Callable[[], asyncio.Future],
+                       ctx: Any = None) -> asyncio.Future:
         """Miss path: join the in-flight computation for ``key`` or lead a
         new one by calling ``submit()`` (which may raise, e.g. QueueFull —
         propagated to the caller with nothing registered).
 
         Returns a per-caller waiter future. Cancelling a waiter (client
         disconnect, HTTP timeout) never cancels the underlying batch slot or
-        the other waiters; the flight still completes and populates."""
+        the other waiters; the flight still completes and populates.
+        ``ctx`` (obs.TraceContext) makes coalescing traceable: the leader's
+        trace id is stored on the flight, and every joining waiter records
+        a ``coalesced`` link span naming it (ISSUE 12)."""
         loop = asyncio.get_running_loop()
         if self.cfg.coalesce:
             fl = self._flights.get(key)
@@ -171,10 +180,15 @@ class ModelCache:
                 w = loop.create_future()
                 fl.waiters.append(w)
                 self._c_coalesced.inc()
+                if ctx is not None:
+                    now = time.time()
+                    ctx.span("coalesced", now, now, tid=self.name,
+                             linked_trace=fl.leader_trace)
                 return w
         base = submit()
         self._c_misses.inc()
-        fl = _Flight(key=key, version=self._version_fn(), waiters=[])
+        fl = _Flight(key=key, version=self._version_fn(), waiters=[],
+                     leader_trace=ctx.trace_id if ctx is not None else None)
         if self.cfg.coalesce:
             self._flights[key] = fl
         w = loop.create_future()

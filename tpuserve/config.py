@@ -266,6 +266,39 @@ class GenserveConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Request-scoped distributed tracing (``[trace]`` TOML; tpuserve.obs,
+    docs/OBSERVABILITY.md).
+
+    Every HTTP request gets a 128-bit trace context at ingest (adopted
+    from ``X-Trace-Id`` when the router tier already stamped one) and the
+    id comes back as an ``X-Trace-Id`` response header on EVERY response,
+    errors included — that part is unconditional, the contract clients and
+    the router rely on. This block sizes what gets RETAINED: the flight
+    recorder's slowest-N-per-model reservoir, the errored-request FIFO,
+    and whether /metrics histograms render per-bucket trace-id
+    exemplars."""
+
+    # Slowest-N complete span trees retained per model for /debug/slow;
+    # 0 disables the slow reservoir (errors still record).
+    slow_n: int = 16
+    # Record every errored/shed request (HTTP status >= 400) even when
+    # fast — a shed 503 or fast 504 is exactly what gets reported.
+    always_record_errors: bool = True
+    # Errored-request span trees retained (FIFO beyond it).
+    error_capacity: int = 256
+    # Render per-bucket trace-id exemplars on /metrics histogram bucket
+    # lines (OpenMetrics exemplar syntax), so a dashboard p99 bucket names
+    # a recorded trace to click through to.
+    exemplars: bool = True
+
+    def __post_init__(self) -> None:
+        if self.slow_n < 0 or self.error_capacity < 0:
+            raise ValueError(
+                "trace.slow_n/error_capacity must be >= 0")
+
+
+@dataclass
 class ParallelConfig:
     """Multi-chip serving plan (``[parallel]`` TOML; docs/PERFORMANCE.md
     "Serving on the mesh").
@@ -680,6 +713,9 @@ class ServerConfig:
     roofline_probe_iters: int = 0
     # Observability: max request-trace events kept for /debug/trace.
     trace_capacity: int = 65536
+    # Request-scoped distributed tracing: flight-recorder reservoir sizes
+    # and metric exemplars (docs/OBSERVABILITY.md).
+    trace: TraceConfig = field(default_factory=TraceConfig)
     # Emit one JSON object per log line (machine-ingestible) instead of the
     # human-readable default.
     log_json: bool = False
@@ -738,6 +774,7 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
+    trace_dict = raw.pop("trace", None)
     parallel_dict = raw.pop("parallel", None)
     genserve_dict = raw.pop("genserve", None)
     scheduler_dict = raw.pop("scheduler", None)
@@ -752,6 +789,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     cfg.models = [_build(ModelConfig, m) for m in model_dicts]
     if dist_dict is not None:
         cfg.distributed = _build(DistributedConfig, dist_dict)
+    if trace_dict is not None:
+        cfg.trace = _build(TraceConfig, trace_dict)
     if parallel_dict is not None:
         cfg.parallel = _build(ParallelConfig, parallel_dict)
     if genserve_dict is not None:

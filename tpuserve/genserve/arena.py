@@ -35,6 +35,9 @@ class SlotInfo:
     enqueued_at: float = 0.0
     admitted_at: float = 0.0
     iterations: int = 0
+    # Request trace context (obs.TraceContext, ISSUE 12): the engine tags
+    # this slot's fold-in/step/evict/retire events with its trace id.
+    ctx: Any = None
     meta: dict = field(default_factory=dict)
 
 

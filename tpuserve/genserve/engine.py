@@ -66,6 +66,8 @@ class _GenRequest:
     # Priority class resolved at admission (obs.PRIORITIES); None when the
     # fleet scheduler is off.
     priority: str | None = None
+    # Request trace context (obs.TraceContext, ISSUE 12); None untraced.
+    ctx: Any = None
 
 
 class GenEngine:
@@ -265,11 +267,14 @@ class GenEngine:
     # -- submission (event loop) ----------------------------------------------
     def submit(self, item: Any, group: Any = None,
                deadline_at: float | None = None,
-               priority: str | None = None) -> asyncio.Future:
+               priority: str | None = None,
+               ctx: Any = None) -> asyncio.Future:
         """Enqueue one decoded request; returns a Future of its result.
         ``group`` is accepted for batcher-API parity and ignored — the
         engine has one slot block, not per-group queues. ``priority``
-        labels the queue-wait histogram (arbitration happened upstream)."""
+        labels the queue-wait histogram (arbitration happened upstream).
+        ``ctx`` (obs.TraceContext) collects the request's queue/fold-in/
+        step/evict/retire spans, tagged with its slot (ISSUE 12)."""
         if not self._running or self._work_event is None:
             raise RuntimeError(f"engine for {self.name} not started")
         if len(self._pending) >= self.cfg.max_queue:
@@ -278,7 +283,7 @@ class GenEngine:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append(_GenRequest(
             item=item, future=fut, enqueued_at=time.perf_counter(),
-            deadline_at=deadline_at, priority=priority))
+            deadline_at=deadline_at, priority=priority, ctx=ctx))
         self._g_queue_depth.set(len(self._pending))
         self._idle_event.clear()
         self._work_event.set()
@@ -317,7 +322,22 @@ class GenEngine:
                 t0 = time.perf_counter()
                 out = await self.stages.run(name, "fetch", self._step_sync)
                 step_ms = (time.perf_counter() - t0) * 1e3
-                self._h_step.observe(step_ms)
+                # Step events per traced slot (ISSUE 12): every mid-flight
+                # request's tree shows each iteration it rode, tagged with
+                # its slot — bounded by the model's own step cap, and what
+                # makes "why was THIS generation slow" answerable span by
+                # span. The histogram exemplar samples one rider.
+                wall = time.time()
+                ex_tid = None
+                for s in self.arena.active_slots():
+                    info = self.arena.peek(s)
+                    if info.ctx is not None:
+                        if ex_tid is None:
+                            ex_tid = info.ctx.trace_id
+                        info.ctx.span("gen_step", wall - step_ms / 1e3,
+                                      wall, tid=name, slot=s,
+                                      iteration=info.iterations)
+                self._h_step.observe(step_ms, trace_id=ex_tid)
                 self._observe_step(step_ms)
                 if self.device_time_cb is not None:
                     self.device_time_cb(step_ms / 1e3)
@@ -389,6 +409,10 @@ class GenEngine:
                     f"({(now - info.enqueued_at) * 1e3:.0f} ms total)"))
                 self._c_deadline.inc()
                 self._c_evictions.inc()
+                if info.ctx is not None:
+                    wall = time.time()
+                    info.ctx.span("evict", wall, wall, tid=self.name,
+                                  slot=slot, iterations=info.iterations)
                 self.arena.release(slot)
         self._g_active.set(self.arena.n_active)
 
@@ -413,12 +437,18 @@ class GenEngine:
                        for s in self.arena.active_slots())
             info = SlotInfo(item=req.item, future=req.future,
                             deadline_at=req.deadline_at,
-                            enqueued_at=req.enqueued_at, admitted_at=now)
+                            enqueued_at=req.enqueued_at, admitted_at=now,
+                            ctx=req.ctx)
             slot = self.arena.acquire(info)
             wait_ms = (now - req.enqueued_at) * 1e3
-            self._h_queue.observe(wait_ms)
+            trace_id = req.ctx.trace_id if req.ctx is not None else None
+            self._h_queue.observe(wait_ms, trace_id=trace_id)
             self._h_qwait[req.priority or self._default_priority].observe(
-                wait_ms)
+                wait_ms, trace_id=trace_id)
+            if req.ctx is not None:
+                wall = time.time()
+                req.ctx.span("queue", wall - wait_ms / 1e3, wall,
+                             tid=self.name)
             t0 = time.perf_counter()
             try:
                 await self.stages.run(self.name, "h2d", self._insert_sync,
@@ -434,7 +464,16 @@ class GenEngine:
                     req.future.set_exception(e)
                 await self._fail_active(e)
                 return
-            self._h_insert.observe((time.perf_counter() - t0) * 1e3)
+            insert_s = time.perf_counter() - t0
+            self._h_insert.observe(insert_s * 1e3, trace_id=trace_id)
+            if req.ctx is not None:
+                # "fold_in" = admitted into an ALREADY-generating block
+                # (the continuous-batching property); "admit" = joined a
+                # fresh one. Span covers the compiled insert program.
+                wall = time.time()
+                req.ctx.span("fold_in" if fold else "admit",
+                             wall - insert_s, wall, tid=self.name,
+                             slot=slot)
             self._c_admitted.inc()
             admitted += 1
             if fold:
@@ -463,11 +502,13 @@ class GenEngine:
             if not self.model.is_finished(out, slot):
                 continue
             early = self.arena.n_active > 1 or bool(self._pending)
+            trace_id = info.ctx.trace_id if info.ctx is not None else None
             t0 = time.perf_counter()
             try:
                 extracted = await self.stages.run(
                     self.name, "fetch", self._extract_sync, slot)
-                self._h_extract.observe((time.perf_counter() - t0) * 1e3)
+                self._h_extract.observe((time.perf_counter() - t0) * 1e3,
+                                        trace_id=trace_id)
                 result = await self.stages.run(
                     self.name, "postproc", self.model.finalize, extracted,
                     info.item)
@@ -491,10 +532,17 @@ class GenEngine:
                 if self.breaker is not None:
                     self.breaker.record_success()
                 wall1 = time.time()
+                if info.ctx is not None:
+                    # Retire event: extract + finalize for this slot, the
+                    # tail of the request's step-span stack.
+                    info.ctx.span("retire", wall1 - (time.perf_counter() - t0),
+                                  wall1, tid=self.name, slot=slot,
+                                  iterations=info.iterations)
                 self.metrics.tracer.add(
                     f"gen[{info.iterations}it]",
                     wall1 - (time.perf_counter() - info.enqueued_at), wall1,
-                    tid=self.name, iterations=info.iterations)
+                    tid=self.name, trace_id=trace_id, slot=slot,
+                    iterations=info.iterations)
             self.arena.release(slot)
         self._g_active.set(self.arena.n_active)
         self._maybe_idle()
@@ -508,9 +556,14 @@ class GenEngine:
         self._c_batch_errors.inc()
         if self.breaker is not None:
             self.breaker.record_failure()
+        wall = time.time()
         for info in self.arena.release_all():
             if not info.future.done():
                 info.future.set_exception(e)
+            if info.ctx is not None:
+                info.ctx.span("engine_failure", wall, wall, tid=self.name,
+                              iterations=info.iterations,
+                              error=type(e).__name__)
         self._state = self._host_zeros(self._state_struct)
         self._g_active.set(0)
         self._maybe_idle()

@@ -6,12 +6,19 @@ p50/p99 latency. The build records:
 
 - counters (requests, errors, images served),
 - fixed-bucket latency histograms split by phase
-  (queue / preproc / h2d / compute / total),
+  (queue / preproc / h2d / compute / total), with per-bucket trace-id
+  exemplars ([trace] exemplars; docs/OBSERVABILITY.md),
 - gauges (queue depth, batch fill ratio, pipeline occupancy
   ``pipeline_inflight{model=}``, per-stage executor queue depth
   ``pipeline_stage_depth{model=,stage=}``),
-- a bounded ring buffer of request-scoped span events, dumpable as
-  Chrome ``chrome://tracing`` JSON.
+- a bounded ring buffer of span events, dumpable as Chrome
+  ``chrome://tracing`` JSON,
+- request-scoped distributed tracing (ISSUE 12): a ``TraceContext``
+  minted per HTTP request (128-bit trace id, returned as ``X-Trace-Id``
+  on every response) collects completed spans across every layer and
+  process the request crosses, and a ``FlightRecorder`` retains the
+  complete span trees of the slowest-N requests per model plus every
+  errored/shed request for ``/debug/slow`` and ``/debug/trace``.
 
 Everything is in-process and designed for a single asyncio event loop plus a
 decode threadpool: histogram/counter updates take a short lock (contention is
@@ -21,8 +28,11 @@ lock).
 
 from __future__ import annotations
 
+import bisect
+import heapq
 import json
 import math
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -41,27 +51,40 @@ def _default_latency_buckets() -> list[float]:
 
 
 class Histogram:
-    """Fixed-bucket histogram (milliseconds by default)."""
+    """Fixed-bucket histogram (milliseconds by default).
 
-    def __init__(self, name: str, buckets: list[float] | None = None) -> None:
+    ``exemplars=True`` keeps, per bucket, the LAST (trace_id, value,
+    timestamp) observed there (ISSUE 12): a dashboard's p99 bucket then
+    names a concrete recorded trace to click through to
+    (docs/OBSERVABILITY.md "Exemplars"). The slot is overwritten on every
+    traced observation, so memory is bounded at one tuple per bucket."""
+
+    def __init__(self, name: str, buckets: list[float] | None = None,
+                 exemplars: bool = False) -> None:
         self.name = name
         self.bounds = buckets or _default_latency_buckets()
         self.counts = [0] * (len(self.bounds) + 1)
         self.total = 0.0
         self.n = 0
+        # bucket index -> (trace_id, observed value, unix ts); None when
+        # exemplars are disabled so the hot path pays a single None check.
+        self._exemplars: dict[int, tuple[str, float, float]] | None = (
+            {} if exemplars else None)
         self._lock = new_lock("obs.Histogram")
 
-    def observe(self, value: float) -> None:
-        i = 0
-        for i, b in enumerate(self.bounds):  # noqa: B007
-            if value <= b:
-                break
-        else:
-            i = len(self.bounds)
+    def observe(self, value: float, trace_id: str | None = None) -> None:
+        # bisect_left returns the first bound >= value — identical bucket
+        # assignment to the old linear scan (first bound with value <= b,
+        # overflow past the last), in O(log 55) instead of O(55) on every
+        # hot-path observation (ISSUE 12 satellite; equivalence pinned by
+        # tests/test_obs.py::test_observe_bisect_matches_linear_scan).
+        i = bisect.bisect_left(self.bounds, value)
         with self._lock:
             self.counts[i] += 1
             self.total += value
             self.n += 1
+            if trace_id is not None and self._exemplars is not None:
+                self._exemplars[i] = (trace_id, value, time.time())
 
     def quantile(self, q: float) -> float:
         """Approximate quantile, linearly interpolated inside the bucket that
@@ -91,7 +114,11 @@ class Histogram:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"n": self.n, "total": self.total, "counts": list(self.counts)}
+            out = {"n": self.n, "total": self.total,
+                   "counts": list(self.counts)}
+            if self._exemplars:
+                out["exemplars"] = dict(self._exemplars)
+            return out
 
 
 class Counter:
@@ -123,36 +150,334 @@ class SpanEvent:
     dur_us: float
     tid: str = "main"  # logical track: model name or "http"
     args: dict = field(default_factory=dict)
+    # Trace identity (ISSUE 12): the request trace this span belongs to,
+    # when the emitting layer knows one (batch spans carry a sample member;
+    # engine retire spans the retiring slot's). None for anonymous spans.
+    trace_id: str | None = None
+    # Process lane in a stitched Chrome trace: 0 = router / single-process
+    # server, worker id + 1 behind the router tier.
+    pid: int = 0
 
 
 class Tracer:
-    """Bounded ring buffer of spans; dumps Chrome trace JSON."""
+    """Bounded ring buffer of spans; dumps Chrome trace JSON.
+
+    The ring keeps the NEWEST ``capacity`` spans (deque maxlen semantics:
+    overflow drops the oldest) — a post-incident pull always sees the most
+    recent window, never a frozen prefix."""
 
     def __init__(self, capacity: int = 65536) -> None:
         self._events: deque[SpanEvent] = deque(maxlen=capacity)
         self._lock = new_lock("obs.Tracer")
 
-    def add(self, name: str, start_s: float, end_s: float, tid: str = "main", **args) -> None:
-        ev = SpanEvent(name, start_s * 1e6, (end_s - start_s) * 1e6, tid, args)
+    def add(self, name: str, start_s: float, end_s: float, tid: str = "main",
+            trace_id: str | None = None, pid: int = 0, **args) -> None:
+        ev = SpanEvent(name, start_s * 1e6, (end_s - start_s) * 1e6, tid,
+                       args, trace_id, pid)
         with self._lock:
             self._events.append(ev)
 
-    def chrome_trace(self) -> str:
+    def chrome_trace(self, limit: int | None = None,
+                     since_us: float | None = None) -> str:
+        """Chrome ``chrome://tracing`` JSON of the ring. ``limit`` caps the
+        dump to the NEWEST that many events and ``since_us`` (epoch
+        microseconds) drops older spans — a trace pull on a loaded server
+        must not build a multi-hundred-MB body from a 65536-event ring on
+        the event loop (ISSUE 12 satellite; the HTTP layer defaults
+        limit=5000)."""
         with self._lock:
             events = list(self._events)
-        out = [
-            {
+        if since_us is not None:
+            events = [e for e in events if e.ts_us >= since_us]
+        if limit is not None and limit >= 0:
+            # NOT events[-limit:]: -0 slices the WHOLE list.
+            events = events[len(events) - limit:] if limit else []
+        out = []
+        for e in events:
+            args = dict(e.args)
+            if e.trace_id is not None:
+                args["trace_id"] = e.trace_id
+            out.append({
                 "name": e.name,
                 "ph": "X",
                 "ts": e.ts_us,
                 "dur": e.dur_us,
-                "pid": 0,
+                "pid": e.pid,
                 "tid": e.tid,
-                "args": e.args,
-            }
-            for e in events
-        ]
+                "args": args,
+            })
         return json.dumps({"traceEvents": out})
+
+
+# -- request-scoped tracing (ISSUE 12) ----------------------------------------
+
+_TRACE_ID_HEX = 32  # 128-bit trace id
+_SPAN_ID_HEX = 16   # 64-bit span id
+
+
+def _hex_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def valid_trace_id(value) -> bool:
+    """True for a well-formed 128-bit lowercase-hex trace id (the wire
+    format of X-Trace-Id). Malformed ids from clients are replaced with a
+    fresh mint, never echoed."""
+    if not isinstance(value, str) or len(value) != _TRACE_ID_HEX:
+        return False
+    return all(c in "0123456789abcdef" for c in value)
+
+
+def _valid_span_id(value) -> bool:
+    if not isinstance(value, str) or len(value) != _SPAN_ID_HEX:
+        return False
+    return all(c in "0123456789abcdef" for c in value)
+
+
+class TraceContext:
+    """One request's trace identity plus its collected spans.
+
+    Minted at ingest (one per HTTP request, adopted from ``X-Trace-Id``
+    when an upstream tier — the router — already stamped one); every layer
+    the request crosses appends COMPLETED spans. There is deliberately no
+    "current span" stack: spans are recorded after the fact with explicit
+    wall-clock bounds, so recording is safe from any thread or event loop
+    (``list.append`` is atomic) and costs one small dict per span.
+
+    The span tree is reconstructed from ``parent_id``: the root span is
+    the HTTP request itself (``span_id == root_id``; ``parent_id`` points
+    at the upstream attempt span when the router relayed us), and every
+    ``span()`` call without an explicit parent hangs off the root. ``pid``
+    labels the process lane in a stitched Chrome trace (0 = router or
+    single-process server, worker id + 1 behind the router tier), which is
+    what makes the cross-process hop visible as a gap between lanes.
+
+    Span dict fields (the flight-recorder/chrome contract, pinned by
+    tests/test_trace.py): name, trace_id, span_id, parent_id, ts_us,
+    dur_us, tid, pid, args.
+    """
+
+    __slots__ = ("trace_id", "root_id", "parent_id", "pid", "spans")
+
+    def __init__(self, trace_id: str | None = None,
+                 parent_id: str | None = None, pid: int = 0) -> None:
+        self.trace_id = trace_id if valid_trace_id(trace_id) \
+            else _hex_id(_TRACE_ID_HEX // 2)
+        self.parent_id = parent_id if _valid_span_id(parent_id) else None
+        self.root_id = _hex_id(_SPAN_ID_HEX // 2)
+        self.pid = pid
+        self.spans: list[dict] = []
+
+    @classmethod
+    def from_headers(cls, headers, pid: int = 0) -> "TraceContext":
+        """Adopt the upstream trace identity (X-Trace-Id / X-Parent-Span)
+        or mint a fresh one. Invalid ids mint rather than propagate."""
+        return cls(trace_id=headers.get("X-Trace-Id"),
+                   parent_id=headers.get("X-Parent-Span"), pid=pid)
+
+    def new_span_id(self) -> str:
+        """Preallocate a span id (the router allocates one per relay
+        attempt BEFORE dispatch so the worker can parent under it)."""
+        return _hex_id(_SPAN_ID_HEX // 2)
+
+    def span(self, name: str, start_s: float, end_s: float, *,
+             span_id: str | None = None, parent_id: str | None = None,
+             tid: str = "req", **args) -> str:
+        """Record one completed span (wall-clock seconds); returns its
+        span id. Default parent is the request's root span."""
+        sid = span_id or _hex_id(_SPAN_ID_HEX // 2)
+        self.spans.append({
+            "name": name,
+            "trace_id": self.trace_id,
+            "span_id": sid,
+            "parent_id": self.root_id if parent_id is None else parent_id,
+            "ts_us": start_s * 1e6,
+            "dur_us": max(0.0, end_s - start_s) * 1e6,
+            "tid": tid,
+            "pid": self.pid,
+            "args": args,
+        })
+        return sid
+
+    def root_span(self, name: str, start_s: float, end_s: float,
+                  tid: str = "req", **args) -> str:
+        """Record the request's root span (span_id = root_id, parented
+        under the upstream attempt span when one was relayed)."""
+        self.spans.append({
+            "name": name,
+            "trace_id": self.trace_id,
+            "span_id": self.root_id,
+            "parent_id": self.parent_id,
+            "ts_us": start_s * 1e6,
+            "dur_us": max(0.0, end_s - start_s) * 1e6,
+            "tid": tid,
+            "pid": self.pid,
+            "args": args,
+        })
+        return self.root_id
+
+
+def spans_to_chrome(spans: Iterable[dict]) -> str:
+    """Render recorded span dicts (the TraceContext format) as Chrome
+    ``chrome://tracing`` JSON. Each event carries the documented fields —
+    name / ph="X" / ts / dur / pid / tid / args — with the trace identity
+    (trace_id, span_id, parent_id) folded into args; ``pid`` separates
+    process lanes so a router→worker hop reads as a gap between lanes."""
+    out = []
+    for s in spans:
+        args = dict(s.get("args") or {})
+        args["trace_id"] = s.get("trace_id")
+        args["span_id"] = s.get("span_id")
+        args["parent_id"] = s.get("parent_id")
+        out.append({
+            "name": s.get("name", ""),
+            "ph": "X",
+            "ts": float(s.get("ts_us", 0.0)),
+            "dur": float(s.get("dur_us", 0.0)),
+            "pid": int(s.get("pid", 0)),
+            "tid": s.get("tid", "req"),
+            "args": args,
+        })
+    out.sort(key=lambda e: e["ts"])
+    return json.dumps({"traceEvents": out})
+
+
+class FlightRecorder:
+    """Tail-latency flight recorder (ISSUE 12): a bounded reservoir of
+    COMPLETE span trees for the requests worth keeping —
+
+    - the slowest ``slow_n`` requests per model (a min-heap keyed by
+      duration: a new request bumps the FASTEST retained entry, so under
+      churn the reservoir converges on the true tail), and
+    - every errored/shed request (HTTP status >= 400) in FIFO order up to
+      ``error_capacity``, retained even when fast — a shed 503 or fast 504
+      is exactly the request an operator gets paged about.
+
+    Dumped at ``GET /debug/slow`` (summaries + span trees) and
+    ``GET /debug/trace?trace_id=...`` (one tree, Chrome format); behind
+    the router tier the router's version stitches worker spans in.
+    Thread-safe: finish() is called from every ingest accept loop."""
+
+    def __init__(self, slow_n: int = 16, error_capacity: int = 256,
+                 always_record_errors: bool = True,
+                 metrics: "Metrics | None" = None) -> None:
+        self.slow_n = max(0, int(slow_n))
+        self.error_capacity = max(0, int(error_capacity))
+        self.always_record_errors = always_record_errors
+        self._metrics = metrics
+        self._rec_counters: dict[tuple[str, str], Counter] = {}
+        # model -> min-heap of (duration_ms, seq, record); heap[0] is the
+        # FASTEST retained record, evicted first when the heap is full.
+        self._slow: dict[str, list] = {}
+        self._errors: deque = deque()
+        self._by_id: dict[str, dict] = {}
+        self._seq = 0
+        self._lock = new_lock("obs.FlightRecorder")
+
+    def _counter(self, model: str, kind: str) -> "Counter | None":
+        if self._metrics is None:
+            return None
+        c = self._rec_counters.get((model, kind))
+        if c is None:
+            c = self._rec_counters[(model, kind)] = self._metrics.counter(
+                f"trace_recorded_total{{model={model},kind={kind}}}")
+        return c
+
+    @staticmethod
+    def _make_record(ctx: TraceContext, model: str, status: int,
+                     duration_ms: float) -> dict:
+        return {
+            "trace_id": ctx.trace_id,
+            "model": model,
+            "status": int(status),
+            "duration_ms": round(duration_ms, 3),
+            "ts": time.time(),
+            "spans": list(ctx.spans),
+            "_slow": False,
+            "_err": False,
+        }
+
+    def _maybe_drop(self, record: dict) -> None:
+        """Forget a record no reservoir retains anymore."""
+        if not record["_slow"] and not record["_err"]:
+            self._by_id.pop(record["trace_id"], None)
+
+    def finish(self, ctx: TraceContext, model: str, status: int,
+               duration_ms: float) -> bool:
+        """Offer one completed request to the reservoirs; True if any
+        retained it. Called once per HTTP request, errors included."""
+        kinds: list[str] = []
+        with self._lock:
+            record: dict | None = None
+            if status >= 400 and self.always_record_errors \
+                    and self.error_capacity > 0:
+                record = self._make_record(ctx, model, status, duration_ms)
+                record["_err"] = True
+                self._errors.append(record)
+                if len(self._errors) > self.error_capacity:
+                    old = self._errors.popleft()
+                    old["_err"] = False
+                    self._maybe_drop(old)
+                kinds.append("error")
+            if self.slow_n > 0:
+                heap = self._slow.setdefault(model, [])
+                if len(heap) < self.slow_n or duration_ms > heap[0][0]:
+                    if record is None:
+                        record = self._make_record(ctx, model, status,
+                                                   duration_ms)
+                    record["_slow"] = True
+                    self._seq += 1
+                    heapq.heappush(heap, (duration_ms, self._seq, record))
+                    if len(heap) > self.slow_n:
+                        _, _, old = heapq.heappop(heap)
+                        old["_slow"] = False
+                        self._maybe_drop(old)
+                    kinds.append("slow")
+            if record is not None:
+                self._by_id[record["trace_id"]] = record
+        for kind in kinds:
+            c = self._counter(model, kind)
+            if c is not None:
+                c.inc()
+        return bool(kinds)
+
+    @staticmethod
+    def _public(record: dict) -> dict:
+        return {k: v for k, v in record.items() if not k.startswith("_")}
+
+    def get(self, trace_id: str) -> dict | None:
+        """The retained record for one trace id (full span tree), or None
+        once both reservoirs have let it go."""
+        with self._lock:
+            rec = self._by_id.get(trace_id)
+            return self._public(rec) if rec is not None else None
+
+    def dump(self, model: str | None = None) -> dict:
+        """The /debug/slow body: per-model slowest-first records plus the
+        errored-request FIFO (newest first), complete span trees included
+        (the reservoirs are small by construction)."""
+        with self._lock:
+            slow = {
+                m: [self._public(r)
+                    for _, _, r in sorted(heap, key=lambda t: -t[0])]
+                for m, heap in self._slow.items()
+                if model is None or m == model
+            }
+            errors = [self._public(r) for r in reversed(self._errors)
+                      if model is None or r["model"] == model]
+        return {"slow": slow, "errors": errors,
+                "slow_n": self.slow_n, "error_capacity": self.error_capacity}
+
+    def stats(self) -> dict:
+        """The /stats "trace" block: reservoir occupancy only."""
+        with self._lock:
+            return {
+                "slow_n": self.slow_n,
+                "slow": {m: len(h) for m, h in self._slow.items()},
+                "errors": len(self._errors),
+                "error_capacity": self.error_capacity,
+                "records": len(self._by_id),
+            }
 
 
 # Per-request/per-batch phase labels on latency_ms{model=,phase=}. The
@@ -221,11 +546,16 @@ SCHED_SHED_REASONS = ("deadline_unmeetable", "priority_shed",
 class Metrics:
     """Registry of all server metrics. One instance per server process."""
 
-    def __init__(self, trace_capacity: int = 65536) -> None:
+    def __init__(self, trace_capacity: int = 65536,
+                 exemplars: bool = True) -> None:
         self._lock = new_lock("obs.Metrics")
         self._histograms: dict[str, Histogram] = {}
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
+        # [trace] exemplars: histograms record per-bucket (trace_id, value,
+        # ts) exemplars, rendered in OpenMetrics exemplar syntax on
+        # /metrics (docs/OBSERVABILITY.md "Exemplars").
+        self.exemplars = exemplars
         self.tracer = Tracer(trace_capacity)
         self.started_at = time.time()
 
@@ -234,7 +564,8 @@ class Metrics:
         with self._lock:
             h = self._histograms.get(name)
             if h is None:
-                h = self._histograms[name] = Histogram(name)
+                h = self._histograms[name] = Histogram(
+                    name, exemplars=self.exemplars)
             return h
 
     def counter(self, name: str) -> Counter:
@@ -390,11 +721,26 @@ class Metrics:
                 typed.add(base)
                 lines.append(f"# TYPE {base} histogram")
             snap = h.snapshot()
+            # OpenMetrics exemplar syntax on bucket lines ([trace]
+            # exemplars): `... <count> # {trace_id="..."} <value> <ts>` —
+            # the last trace id observed in that bucket, so a dashboard's
+            # p99 bucket names a recorded trace to pull from /debug/trace.
+            exemplars = snap.get("exemplars") or {}
+
+            def _ex(i: int) -> str:
+                e = exemplars.get(i)
+                if e is None:
+                    return ""
+                tid, val, ts = e
+                return f' # {{trace_id="{tid}"}} {val:g} {ts:.3f}'
+
             acc = 0
-            for bound, count in zip(h.bounds, snap["counts"]):
+            for i, (bound, count) in enumerate(zip(h.bounds, snap["counts"])):
                 acc += count
-                lines.append(f'{base}_bucket{{{labels}le="{bound:g}"}} {acc}')
-            lines.append(f'{base}_bucket{{{labels}le="+Inf"}} {snap["n"]}')
+                lines.append(
+                    f'{base}_bucket{{{labels}le="{bound:g}"}} {acc}{_ex(i)}')
+            lines.append(f'{base}_bucket{{{labels}le="+Inf"}} {snap["n"]}'
+                         f'{_ex(len(h.bounds))}')
             lines.append(f"{base}_sum{{{labels.rstrip(',')}}} {snap['total']}")
             lines.append(f"{base}_count{{{labels.rstrip(',')}}} {snap['n']}")
         return "\n".join(lines) + "\n"

@@ -17,7 +17,10 @@ All device work happens behind the batcher. Endpoints:
 - ``GET  /healthz``     — liveness + per-model canary status.
 - ``GET  /metrics``     — Prometheus text format.
 - ``GET  /stats``       — JSON latency/throughput summary.
-- ``GET  /debug/trace`` — Chrome trace JSON of recent request spans.
+- ``GET  /debug/trace`` — Chrome trace JSON: the span ring (``?limit=``,
+  ``?since_us=``) or one recorded request's tree (``?trace_id=``).
+- ``GET  /debug/slow``  — flight recorder: slowest-N span trees per model
+  plus every errored/shed request (docs/OBSERVABILITY.md).
 - ``GET  /v1/models``   — model inventory (buckets, mesh, dtype).
 - ``GET  /``            — minimal HTML upload page for manual poking.
 - ``POST /admin/models/{name}:reload``   — staged, canary-gated weight swap
@@ -27,6 +30,11 @@ All device work happens behind the batcher. Endpoints:
 Error mapping: decode failure -> 400, unknown model -> 404, queue full -> 429,
 request deadline exceeded -> 504, batch failure (after retry) -> 500, breaker
 open / draining -> 503. Shed responses (429/503) carry ``Retry-After``.
+
+Every predict response — success OR error — carries an ``X-Trace-Id``
+header (ISSUE 12): the request's 128-bit trace id, minted at ingest or
+adopted from the router tier, joining the response to its recorded span
+tree in the flight recorder. Error JSON bodies repeat it as ``trace_id``.
 """
 
 from __future__ import annotations
@@ -61,7 +69,8 @@ from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
 from tpuserve.genserve import GenEngine
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
-from tpuserve.obs import PRIORITIES, Metrics
+from tpuserve.obs import (PRIORITIES, FlightRecorder, Metrics, TraceContext,
+                          spans_to_chrome)
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
 from tpuserve.scheduler import FleetScheduler
 
@@ -146,7 +155,18 @@ class ServerState:
 
     def __init__(self, cfg: ServerConfig) -> None:
         self.cfg = cfg
-        self.metrics = Metrics(cfg.trace_capacity)
+        self.metrics = Metrics(cfg.trace_capacity,
+                               exemplars=cfg.trace.exemplars)
+        # Tail-latency flight recorder (ISSUE 12, docs/OBSERVABILITY.md):
+        # complete span trees for the slowest-N requests per model plus
+        # every errored/shed request, served at /debug/slow and
+        # /debug/trace?trace_id=. Thread-safe — every ingest accept loop
+        # finishes its own requests into it.
+        self.recorder = FlightRecorder(
+            slow_n=cfg.trace.slow_n,
+            error_capacity=cfg.trace.error_capacity,
+            always_record_errors=cfg.trace.always_record_errors,
+            metrics=self.metrics)
         self.pool = cf.ThreadPoolExecutor(max_workers=cfg.decode_threads, thread_name_prefix="tpuserve")
         # Pipelined host execution engine (tpuserve.hostpipe): one dedicated
         # thread pool per stage, shared across every model's batcher so work
@@ -664,6 +684,7 @@ async def _submit_and_gather(state: ServerState, name: str, model,
                              items: list, deadline_at: float,
                              priority: str | None,
                              timeout_ms: float | None,
+                             ctx: "TraceContext | None" = None,
                              ) -> tuple[list, "object | None"]:
     """Cache/single-flight lookup + batcher submission + deadline-bounded
     gather for one decoded request — everything that must run on the main
@@ -685,15 +706,19 @@ async def _submit_and_gather(state: ServerState, name: str, model,
                 if entry is not None:
                     results[i] = entry.value
                     hit_entry = entry
+                    if ctx is not None:
+                        now = time.time()
+                        ctx.span("cache_hit", now, now, tid=name)
                     continue
                 fut = cache.submit_through(
                     key, lambda it=item: batcher.submit(
                         it, group=model.group_key(it),
-                        deadline_at=deadline_at, priority=priority))
+                        deadline_at=deadline_at, priority=priority,
+                        ctx=ctx), ctx=ctx)
             else:
                 fut = batcher.submit(item, group=model.group_key(item),
                                      deadline_at=deadline_at,
-                                     priority=priority)
+                                     priority=priority, ctx=ctx)
             futs.append(fut)
             slots.append(i)
     except QueueFull:
@@ -729,22 +754,48 @@ async def _submit_and_gather(state: ServerState, name: str, model,
 
 
 async def handle_predict(request: web.Request) -> web.Response:
+    """Predict entry: mints (or adopts, behind the router) the request's
+    trace context, delegates to the traced handler, then stamps
+    ``X-Trace-Id`` on the response — EVERY response, success or error —
+    records the root span, and offers the finished trace to the flight
+    recorder (ISSUE 12, docs/OBSERVABILITY.md)."""
     state: ServerState = request.app[STATE_KEY]
     name = request.match_info["name"]
+    # Behind the router tier the worker's spans land on their own process
+    # lane (pid = worker id + 1; the router is lane 0), which is what makes
+    # the cross-process hop visible as a gap in a stitched Chrome trace.
+    ctx = TraceContext.from_headers(
+        request.headers,
+        pid=state.worker_id + 1 if state.worker_id is not None else 0)
+    wall0 = time.time()
+    t0 = time.perf_counter()
+    resp = await _predict_traced(request, state, name, ctx)
+    dur_s = time.perf_counter() - t0
+    ctx.root_span("request", wall0, wall0 + dur_s, tid=name,
+                  status=resp.status)
+    if "X-Trace-Id" not in resp.headers:
+        resp.headers["X-Trace-Id"] = ctx.trace_id
+    state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    return resp
+
+
+async def _predict_traced(request: web.Request, state: ServerState,
+                          name: str, ctx: TraceContext) -> web.Response:
     model = state.models.get(name)
     if model is None:
-        return _err(404, f"unknown model {name!r}")
+        return _err(404, f"unknown model {name!r}", trace=ctx)
     # Shed checks run BEFORE the body read: a draining replica or tripped
     # model answers in microseconds, with a Retry-After hint, instead of
     # paying decode + a doomed dispatch.
     if state.draining:
         return _err(503, "server draining; retry against another replica",
-                    retry_after=state.shed_retry_after())
+                    retry_after=state.shed_retry_after(), trace=ctx)
     breaker = state.breakers.get(name)
     if breaker is not None and not breaker.allow():
         breaker.on_shed()
         return _err(503, f"circuit open for model {name!r}; recovery probe "
-                         "in progress", retry_after=state.breaker_retry_after(name))
+                         "in progress",
+                    retry_after=state.breaker_retry_after(name), trace=ctx)
     # Fleet scheduler admission, part 1 (pre-body; tpuserve.scheduler):
     # warm/cold state and priority arbitration need only headers, so a
     # cold model or shed batch-class request answers in microseconds. The
@@ -764,10 +815,11 @@ async def handle_predict(request: web.Request) -> web.Response:
         try:
             priority, shed = await _on_main(state, _precheck)
         except ValueError as e:
-            return _err(400, str(e))
+            return _err(400, str(e), trace=ctx)
         if shed is not None:
             return _err(shed.status, shed.message,
-                        retry_after=shed.retry_after, reason=shed.reason)
+                        retry_after=shed.retry_after, reason=shed.reason,
+                        trace=ctx)
     elif raw_priority:
         # No scheduler = no arbitration, but the class still labels the
         # queue-wait split (header -> batcher); junk degrades to the
@@ -804,8 +856,12 @@ async def handle_predict(request: web.Request) -> web.Response:
     # SO_REUSEPORT spread picked, not serialized on the batcher's loop.
     ing: IngestHandles = request.app[INGEST_KEY]
     t_read = time.perf_counter()
+    w_read = time.time()
     body = await request.read()
-    h.body_read_hist.observe((time.perf_counter() - t_read) * 1e3)
+    read_s = time.perf_counter() - t_read
+    h.body_read_hist.observe(read_s * 1e3, trace_id=ctx.trace_id)
+    ctx.span("body_read", w_read, w_read + read_s, tid=name,
+             loop=ing.index, bytes=len(body))
     ing.requests.inc()
     ing.bytes.inc(len(body))
     ctype = request.content_type or ""
@@ -818,7 +874,7 @@ async def handle_predict(request: web.Request) -> web.Response:
     try:
         timeout_ms = _requested_timeout_ms(request, body, ctype)
     except ValueError as e:
-        return _err(400, str(e))
+        return _err(400, str(e), trace=ctx)
     timeout_s = (timeout_ms if timeout_ms is not None
                  else mcfg.request_timeout_ms) / 1e3
     deadline_at = t_start + timeout_s
@@ -834,7 +890,8 @@ async def handle_predict(request: web.Request) -> web.Response:
         shed = await _on_main(state, _deadline_check)
         if shed is not None:
             return _err(shed.status, shed.message,
-                        retry_after=shed.retry_after, reason=shed.reason)
+                        retry_after=shed.retry_after, reason=shed.reason,
+                        trace=ctx)
 
     try:
         if state.injector is not None:
@@ -844,6 +901,7 @@ async def handle_predict(request: web.Request) -> web.Response:
         # Framed bodies parse as zero-copy views (tpuserve.frame) — the
         # "parse" phase for them is offset-table validation, not pixel work.
         t_parse = time.perf_counter()
+        w_parse = time.time()
         if state.cfg.decode_inline:
             items, batched = model.host_decode_items(body, ctype)
         else:
@@ -852,16 +910,19 @@ async def handle_predict(request: web.Request) -> web.Response:
                 state.pool, model.host_decode_items, body, ctype)
         if not items:
             raise ValueError("empty batch")
-        h.parse_hist.observe((time.perf_counter() - t_parse) * 1e3)
+        parse_s = time.perf_counter() - t_parse
+        h.parse_hist.observe(parse_s * 1e3, trace_id=ctx.trace_id)
+        ctx.span("parse", w_parse, w_parse + parse_s, tid=name,
+                 items=len(items))
     except frame_wire.FrameError as e:
         # Malformed frame: machine-readable 400 (message is "frame: ..."),
         # never a 500 — and counted apart from generic decode failures.
         h.frame_errors.inc()
         h.bad_requests.inc()
-        return _err(400, str(e))
+        return _err(400, str(e), trace=ctx)
     except Exception as e:
         h.bad_requests.inc()
-        return _err(400, f"could not decode request: {e}")
+        return _err(400, f"could not decode request: {e}", trace=ctx)
 
     # Demand-shaping layer (tpuserve.cache): per item, answer from the
     # content-addressed result cache, join an identical in-flight miss
@@ -871,29 +932,39 @@ async def handle_predict(request: web.Request) -> web.Response:
     # below the decode runs on the MAIN loop (_submit_and_gather): cache,
     # single-flight, batcher, and scheduler state are loop-only by design,
     # so a parallel ingest loop makes exactly ONE hop per request here.
+    w_dispatch = time.time()
+    t_dispatch = time.perf_counter()
     try:
         results, hit_entry = await _on_main(
             state, lambda: _submit_and_gather(
                 state, name, model, items, deadline_at, priority,
-                timeout_ms))
+                timeout_ms, ctx))
     except QueueFull:
         return _err(429, "queue full, retry later",
-                    retry_after=state.queue_retry_after(name))
+                    retry_after=state.queue_retry_after(name), trace=ctx)
     except NotServing as e:
-        return _err(503, f"server not accepting requests: {e}")
+        return _err(503, f"server not accepting requests: {e}", trace=ctx)
     except DeadlineExceeded as e:
         # The batcher rejected the queued work before dispatch: same 504
         # as the timer path, but fast, in deadline_exceeded_total.
-        return _err(504, f"deadline_exceeded: {e}")
+        return _err(504, f"deadline_exceeded: {e}", trace=ctx)
     except asyncio.TimeoutError:
         h.timeouts.inc()
         return _err(504,
-                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
+                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded",
+                    trace=ctx)
     except Exception as e:
-        return _err(500, f"inference failed: {e}")
+        return _err(500, f"inference failed: {e}", trace=ctx)
+    finally:
+        # The ingest-loop→main-loop hop plus everything the main loop ran
+        # (cache, single-flight, batcher/engine): its children are the
+        # queue/phase spans the batcher recorded; a gap between "parse"
+        # and "queue" inside this span IS the cross-loop hop.
+        ctx.span("dispatch", w_dispatch,
+                 w_dispatch + (time.perf_counter() - t_dispatch), tid=name)
 
     total_ms = (time.perf_counter() - t_start) * 1e3
-    h.total_hist.observe(total_ms)
+    h.total_hist.observe(total_ms, trace_id=ctx.trace_id)
     if batched:
         payload = {"results": results}
         if len(results) >= _JSON_OFFLOAD_MIN_ITEMS and not state.cfg.decode_inline:
@@ -951,6 +1022,10 @@ async def handle_stats(request: web.Request) -> web.Response:
     }
     if state.injector is not None:
         out["robustness"]["faults"] = state.injector.snapshot()
+    # Flight-recorder occupancy (docs/OBSERVABILITY.md): how many slow/
+    # errored span trees are retained per model (the trees themselves live
+    # at /debug/slow and /debug/trace?trace_id=).
+    out["trace"] = state.recorder.stats()
     if witness.enabled():
         # Observed lock-order graph + any violations (docs/ANALYSIS.md).
         out["robustness"]["lock_witness"] = witness.snapshot()
@@ -1009,8 +1084,46 @@ async def handle_stats(request: web.Request) -> web.Response:
 
 
 async def handle_trace(request: web.Request) -> web.Response:
+    """GET /debug/trace — Chrome trace JSON.
+
+    ``?trace_id=`` pulls ONE recorded request's complete span tree from the
+    flight recorder (``&format=record`` returns the raw record instead —
+    the router tier stitches worker records into one cross-process trace).
+    Without it, the span ring is dumped, bounded by ``?limit=`` (default
+    5000 — an unbounded 65536-event dump built a multi-hundred-MB body on
+    the event loop of a loaded server) and ``?since_us=`` (epoch µs)."""
     state: ServerState = request.app[STATE_KEY]
-    return web.Response(text=state.metrics.tracer.chrome_trace(), content_type="application/json")
+    trace_id = request.query.get("trace_id")
+    if trace_id:
+        rec = state.recorder.get(trace_id)
+        if rec is None:
+            return _err(404, f"trace {trace_id!r} is not in the flight "
+                             "recorder (evicted or never retained)")
+        if request.query.get("format") == "record":
+            return web.json_response(rec)
+        return web.Response(text=spans_to_chrome(rec["spans"]),
+                            content_type="application/json")
+    try:
+        limit = int(request.query.get("limit", "5000"))
+        since_us = (float(request.query["since_us"])
+                    if "since_us" in request.query else None)
+    except ValueError as e:
+        return _err(400, f"limit/since_us must be numbers: {e}")
+    if limit < 0:
+        return _err(400, f"limit must be >= 0, got {limit}")
+    return web.Response(
+        text=state.metrics.tracer.chrome_trace(limit=limit,
+                                               since_us=since_us),
+        content_type="application/json")
+
+
+async def handle_slow(request: web.Request) -> web.Response:
+    """GET /debug/slow — the flight recorder's reservoirs: slowest-N span
+    trees per model (slowest first) plus the errored-request FIFO (newest
+    first). ``?model=`` filters to one model."""
+    state: ServerState = request.app[STATE_KEY]
+    return web.json_response(state.recorder.dump(
+        model=request.query.get("model")))
 
 
 _INDEX_HTML = """<!doctype html><title>tpuserve</title>
@@ -1122,15 +1235,26 @@ async def handle_index(request: web.Request) -> web.Response:
 
 def _err(status: int, message: str,
          retry_after: int | None = None,
-         reason: str | None = None) -> web.Response:
-    headers = {"Retry-After": str(retry_after)} if retry_after else None
+         reason: str | None = None,
+         trace: "TraceContext | str | None" = None) -> web.Response:
+    headers: dict[str, str] = {}
+    if retry_after:
+        headers["Retry-After"] = str(retry_after)
     body = {"error": message}
     if reason is not None:
         # Machine-readable shed reason (obs.SCHED_SHED_REASONS): the
         # router tier relays it so its own breaker 503s can carry the
         # fleet's live shed cause.
         body["reason"] = reason
-    return web.json_response(body, status=status, headers=headers)
+    if trace is not None:
+        # Trace identity on the ERROR path (ISSUE 12 satellite): the id
+        # rides both the X-Trace-Id header and the JSON body, so a user
+        # report quoting a shed/504 body joins directly against the
+        # flight recorder (/debug/trace?trace_id=...).
+        tid = trace if isinstance(trace, str) else trace.trace_id
+        body["trace_id"] = tid
+        headers["X-Trace-Id"] = tid
+    return web.json_response(body, status=status, headers=headers or None)
 
 
 def _requested_timeout_ms(request: web.Request, body: bytes,
@@ -1190,6 +1314,7 @@ def make_app(state: ServerState, loop_index: int = 0,
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/stats", _main_loop_handler(handle_stats))
     app.router.add_get("/debug/trace", handle_trace)
+    app.router.add_get("/debug/slow", handle_slow)
     app.router.add_get("/", handle_index)
 
     if primary:

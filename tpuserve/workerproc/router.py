@@ -34,6 +34,14 @@ Relay semantics (the robustness contract, docs/ROBUSTNESS.md):
   admitting (503 + Retry-After), waits for its in-flight relays, and only
   then SIGTERMs the workers, each of which flushes its accepted batches
   before exiting. Zero accepted requests dropped.
+- **Tracing** (ISSUE 12, docs/OBSERVABILITY.md) — the router mints each
+  request's 128-bit trace context and every relay attempt crosses the
+  boundary as ``X-Trace-Id`` + ``X-Parent-Span``, so hedged/retried
+  attempts are sibling spans under one trace with the worker's own span
+  tree hanging under each. ``X-Trace-Id`` rides every response;
+  ``/debug/trace?trace_id=`` stitches router + worker records into one
+  Chrome trace (worker id as pid — the hop is a visible gap between
+  process lanes).
 """
 
 from __future__ import annotations
@@ -53,7 +61,7 @@ from tpuserve.analysis import witness
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, Watchdog
-from tpuserve.obs import Metrics
+from tpuserve.obs import FlightRecorder, Metrics, TraceContext, spans_to_chrome
 from tpuserve.server import _err, _requested_timeout_ms, configure_logging
 from tpuserve.workerproc.supervisor import WorkerHandle, WorkerSupervisor
 
@@ -133,7 +141,18 @@ class RouterState:
     def __init__(self, cfg: ServerConfig) -> None:
         self.cfg = cfg
         self.rcfg = cfg.router
-        self.metrics = Metrics(cfg.trace_capacity)
+        self.metrics = Metrics(cfg.trace_capacity,
+                               exemplars=cfg.trace.exemplars)
+        # Router-side flight recorder (ISSUE 12): retains the front door's
+        # view of slow/errored requests — root + per-attempt spans (pid 0).
+        # /debug/trace?trace_id= stitches the matching worker records in
+        # (worker spans carry pid = worker id + 1), so one Chrome trace
+        # shows the request crossing the process boundary.
+        self.recorder = FlightRecorder(
+            slow_n=cfg.trace.slow_n,
+            error_capacity=cfg.trace.error_capacity,
+            always_record_errors=cfg.trace.always_record_errors,
+            metrics=self.metrics)
         self.supervisor = WorkerSupervisor(cfg, self.metrics)
         self.watchdog = Watchdog(cfg.watchdog_interval_s, self.metrics)
         self.handles: dict[str, RouterHandles] = {}
@@ -221,36 +240,55 @@ class RouterState:
     # -- relay ---------------------------------------------------------------
     async def _attempt(self, w: WorkerHandle, name: str, verb: str,
                        body: bytes, ctype: str, deadline_at: float,
-                       priority: str | None = None) -> _Answer:
+                       priority: str | None = None,
+                       ctx: "TraceContext | None" = None) -> _Answer:
         """One complete request/response against one worker. The body is
         fully read before returning, so a relayed response is never torn:
         a worker dying mid-body surfaces as a transport error (and a
         retry), not a truncated 200. ``priority`` relays the client's
         X-Priority so the worker's fleet scheduler arbitrates with the
-        class the client asked for (header -> worker -> batcher)."""
+        class the client asked for (header -> worker -> batcher).
+
+        Trace propagation (ISSUE 12): the request's trace id crosses as
+        ``X-Trace-Id`` and this attempt's pre-allocated span id as
+        ``X-Parent-Span``, so the worker's root span parents under THIS
+        attempt — hedged/retried attempts each appear as sibling attempt
+        spans under one trace, each with its own worker subtree."""
         remaining = deadline_at - time.perf_counter()
         timeout = aiohttp.ClientTimeout(
             total=max(0.001, remaining + _DEADLINE_GRACE_S),
             connect=self.rcfg.connect_timeout_ms / 1e3)
         headers = {"X-Timeout-Ms": f"{max(1.0, remaining * 1e3):.0f}"}
+        span_id = None
+        if ctx is not None:
+            span_id = ctx.new_span_id()
+            headers["X-Trace-Id"] = ctx.trace_id
+            headers["X-Parent-Span"] = span_id
         if priority:
             headers["X-Priority"] = priority
         if ctype:
             headers["Content-Type"] = ctype
         self.supervisor.track_inflight(w, +1)
+        w0 = time.time()
+        outcome: "int | str" = "transport_error"
         try:
             async with self._session.post(
                     f"{w.base_url}/v1/models/{name}:{verb}", data=body,
                     headers=headers, timeout=timeout) as r:
                 raw = await r.read()
+                outcome = r.status
                 return _Answer(r.status, r.content_type or "application/json",
                                raw, r.headers.get("Retry-After"))
         finally:
             self.supervisor.track_inflight(w, -1)
+            if ctx is not None:
+                ctx.span("attempt", w0, time.time(), span_id=span_id,
+                         tid=name, worker=w.wid, status=outcome)
 
     async def _relay(self, name: str, verb: str, body: bytes, ctype: str,
                      deadline_at: float,
-                     priority: str | None = None) -> _Answer:
+                     priority: str | None = None,
+                     ctx: "TraceContext | None" = None) -> _Answer:
         """Dispatch to the least-loaded healthy worker with retry + hedging
         under the absolute deadline. Returns the first definitive answer;
         raises NoHealthyWorker / RelayDeadline / UpstreamFailed."""
@@ -278,7 +316,7 @@ class RouterState:
             tried.add(w.wid)
             t = loop.create_task(
                 self._attempt(w, name, verb, body, ctype, deadline_at,
-                              priority))
+                              priority, ctx))
             tasks[t] = w
             return True
 
@@ -351,13 +389,14 @@ class RouterState:
 
     async def relay_cacheable(self, name: str, verb: str, body: bytes,
                               ctype: str, deadline_at: float,
-                              priority: str | None = None) -> tuple:
+                              priority: str | None = None,
+                              ctx: "TraceContext | None" = None) -> tuple:
         """Cache-value form of _relay: returns ``(content_type, body)`` for
         a 200 (what the single-flight leader populates), raises
         _RelayedError for any other definitive answer (fans out to
         coalesced waiters, populates nothing)."""
         ans = await self._relay(name, verb, body, ctype, deadline_at,
-                                priority)
+                                priority, ctx)
         if ans.status == 200:
             return (ans.content_type, ans.body)
         raise _RelayedError(ans)
@@ -487,17 +526,38 @@ def _predict_handler(verb: str):
 
 
 async def handle_predict(request: web.Request, verb: str) -> web.Response:
+    """Router predict entry: mints the request's trace context (adopting a
+    client-supplied ``X-Trace-Id`` when well-formed), delegates to the
+    relay, then stamps ``X-Trace-Id`` on EVERY response — relayed worker
+    answers included — records the router-side root span, and offers the
+    trace to the router's flight recorder (ISSUE 12)."""
     state: RouterState = request.app[ROUTER_KEY]
     name = request.match_info["name"]
+    ctx = TraceContext.from_headers(request.headers, pid=0)
+    wall0 = time.time()
+    t0 = time.perf_counter()
+    resp = await _predict_relayed(request, state, name, verb, ctx)
+    dur_s = time.perf_counter() - t0
+    ctx.root_span("request", wall0, wall0 + dur_s, tid=name,
+                  status=resp.status)
+    if "X-Trace-Id" not in resp.headers:
+        resp.headers["X-Trace-Id"] = ctx.trace_id
+    state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    return resp
+
+
+async def _predict_relayed(request: web.Request, state: RouterState,
+                           name: str, verb: str,
+                           ctx: TraceContext) -> web.Response:
     h = state.handles.get(name)
     if h is None:
-        return _err(404, f"unknown model {name!r}")
+        return _err(404, f"unknown model {name!r}", trace=ctx)
     # Shed checks BEFORE the body read, single-process discipline: a
     # draining router, a tripped breaker, or an empty fleet answers in
     # microseconds with a live-state Retry-After.
     if state.draining:
         return _err(503, "router draining; retry against another replica",
-                    retry_after=state.shed_retry_after())
+                    retry_after=state.shed_retry_after(), trace=ctx)
     breaker = state.breakers[name]
     if not breaker.allow():
         now = time.monotonic()
@@ -510,14 +570,14 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
             return _err(503, f"circuit open for model {name!r}; recovery "
                              "probe in progress",
                         retry_after=max(1, math.ceil(probe_at - now)),
-                        reason=state.last_shed_reason.get(name))
+                        reason=state.last_shed_reason.get(name), trace=ctx)
         # This request IS the recovery probe: open -> half_open, let it
         # through; its outcome closes or re-opens the breaker.
         breaker.probe()
         state._probe_at[name] = now + h.mcfg.breaker_retry_after_s
     if not state.supervisor.healthy_workers():
         return _err(503, "no healthy worker; capacity respawning",
-                    retry_after=state.no_worker_retry_after())
+                    retry_after=state.no_worker_retry_after(), trace=ctx)
     h.requests.inc()
     t_start = time.perf_counter()
 
@@ -527,12 +587,14 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
     # it (same bytes must hit the same entry regardless of priority).
     priority = request.headers.get("X-Priority")
 
+    w_read = time.time()
     body = await request.read()
+    ctx.span("body_read", w_read, time.time(), tid=name, bytes=len(body))
     ctype = request.content_type or ""
     try:
         timeout_ms = _requested_timeout_ms(request, body, ctype)
     except ValueError as e:
-        return _err(400, str(e))
+        return _err(400, str(e), trace=ctx)
     timeout_s = (timeout_ms if timeout_ms is not None
                  else h.mcfg.request_timeout_ms) / 1e3
     deadline_at = t_start + timeout_s
@@ -540,19 +602,20 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
     state._inflight += 1
     try:
         ans = await _dispatch(state, name, verb, body, ctype, deadline_at,
-                              priority)
+                              priority, ctx)
     except NoHealthyWorker as e:
         breaker.record_failure()
         return _err(503, "no healthy worker; capacity respawning",
-                    retry_after=max(1, math.ceil(e.eta_s)))
+                    retry_after=max(1, math.ceil(e.eta_s)), trace=ctx)
     except (RelayDeadline, asyncio.TimeoutError):
         h.timeouts.inc()
         return _err(504,
-                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded")
+                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded",
+                    trace=ctx)
     except UpstreamFailed:
         breaker.record_failure()
         return _err(503, "workers unreachable; retry",
-                    retry_after=state.no_worker_retry_after())
+                    retry_after=state.no_worker_retry_after(), trace=ctx)
     finally:
         state._inflight -= 1
 
@@ -561,13 +624,15 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
     elif ans.status >= 500:
         breaker.record_failure()
     state.note_shed_reason(name, ans)
-    h.latency.observe((time.perf_counter() - t_start) * 1e3)
+    h.latency.observe((time.perf_counter() - t_start) * 1e3,
+                      trace_id=ctx.trace_id)
     return ans.to_response()
 
 
 async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
                     ctype: str, deadline_at: float,
-                    priority: str | None = None) -> _Answer:
+                    priority: str | None = None,
+                    ctx: "TraceContext | None" = None) -> _Answer:
     """Cache/single-flight front of the relay (router-owned PR-5 layer).
 
     The cache key is content-addressed at the WIRE level — the router has
@@ -578,17 +643,20 @@ async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
     cache = state.caches.get(name)
     if cache is None:
         return await state._relay(name, verb, body, ctype, deadline_at,
-                                  priority)
+                                  priority, ctx)
     key = cache.key_for((verb, ctype, body))
     entry = cache.get(key)
     if entry is not None:
         ct, raw = entry.value
+        if ctx is not None:
+            now = time.time()
+            ctx.span("cache_hit", now, now, tid=name)
         return _Answer(200, ct, raw, None)
     loop = asyncio.get_running_loop()
     fut = cache.submit_through(
         key, lambda: loop.create_task(
             state.relay_cacheable(name, verb, body, ctype, deadline_at,
-                                  priority)))
+                                  priority, ctx)), ctx=ctx)
     # A coalesced waiter still honors ITS deadline: cancelling the waiter
     # never cancels the leader's flight (ModelCache contract).
     remaining = deadline_at - time.perf_counter()
@@ -640,9 +708,63 @@ async def handle_stats(request: web.Request) -> web.Response:
         "retry_max": state.rcfg.retry_max,
         "hedge_ms": state.rcfg.hedge_ms,
     }
+    out["trace"] = state.recorder.stats()
     if state.caches:
         out["cache"] = {n: c.stats() for n, c in state.caches.items()}
     return web.json_response(out)
+
+
+async def handle_slow(request: web.Request) -> web.Response:
+    """GET /debug/slow — the ROUTER's flight recorder: the front-door view
+    (root + per-attempt spans) of the slowest-N requests per model plus
+    every errored/shed request. Pull the stitched cross-process tree for
+    any entry via /debug/trace?trace_id=."""
+    state: RouterState = request.app[ROUTER_KEY]
+    return web.json_response(state.recorder.dump(
+        model=request.query.get("model")))
+
+
+async def handle_trace(request: web.Request) -> web.Response:
+    """GET /debug/trace?trace_id= — one request's STITCHED span tree.
+
+    The router's own record (pid 0: request + attempt spans) is merged
+    with every live worker's record for the same trace id (their spans
+    carry pid = worker id + 1), rendered as one Chrome trace — the
+    router→worker hop reads as a gap between the attempt span on lane 0
+    and the worker's request span on its lane. ``&format=record`` returns
+    the merged raw spans instead (what a higher tier would stitch)."""
+    state: RouterState = request.app[ROUTER_KEY]
+    trace_id = request.query.get("trace_id")
+    if not trace_id:
+        return _err(400, "the router trace endpoint needs ?trace_id=... "
+                         "(find recorded ids at /debug/slow)")
+    spans: list[dict] = []
+    meta: dict = {"trace_id": trace_id, "sources": []}
+    rec = state.recorder.get(trace_id)
+    if rec is not None:
+        spans.extend(rec["spans"])
+        meta["sources"].append("router")
+        meta["model"] = rec["model"]
+        meta["status"] = rec["status"]
+        meta["duration_ms"] = rec["duration_ms"]
+    workers = state.live_workers()
+    if workers:
+        results = await asyncio.gather(
+            *(state._admin_call(
+                w, "GET", f"/debug/trace?trace_id={trace_id}&format=record")
+              for w in workers))
+        for wid, status, body in results:
+            if status == 200 and isinstance(body.get("spans"), list):
+                spans.extend(body["spans"])
+                meta["sources"].append(f"worker{wid}")
+    if not spans:
+        return _err(404, f"trace {trace_id!r} is not recorded on the "
+                         "router or any live worker")
+    if request.query.get("format") == "record":
+        meta["spans"] = spans
+        return web.json_response(meta)
+    return web.Response(text=spans_to_chrome(spans),
+                        content_type="application/json")
 
 
 async def handle_models(request: web.Request) -> web.Response:
@@ -736,6 +858,8 @@ def make_router_app(state: RouterState) -> web.Application:
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/stats", handle_stats)
+    app.router.add_get("/debug/slow", handle_slow)
+    app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/", handle_index)
 
     async def on_startup(app: web.Application) -> None:
