@@ -47,6 +47,9 @@ scripts/trace_smoke.sh
 echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
 scripts/worker_drill.sh
 
+echo "== host drill (killpg an entire host mid-load, survivors >= 99%, sharded-cache router kill) =="
+scripts/host_drill.sh
+
 echo "== fleet drill (poison one model @ 100%, survivors hold >= 99%) =="
 scripts/fleet_drill.sh
 

@@ -1,0 +1,96 @@
+#!/usr/bin/env bash
+# Kill-a-host chaos drill (ISSUE 13): a REAL router over 2 host failure
+# domains x 2 workers each (each host = a supervisor subprocess owning its
+# worker fleet in its own process group), closed-loop load, then ONE
+# killpg(SIGKILL) takes out an entire host mid-load — agent and both
+# workers at once, exactly a machine losing power. Gates
+# (docs/ROBUSTNESS.md "Host failure domains"):
+#   1. availability >= 99% across the whole run, kill included (the host
+#      breaker + retries route around the dead domain in milliseconds);
+#   2. zero torn/duplicate responses: a validator byte-compares every 200
+#      body against a pre-kill reference throughout;
+#   3. the dead host re-absorbs (agent respawned, all its workers healthy)
+#      within the backoff budget;
+#   4. per-worker compile delta 0 on every SURVIVING worker — losing a
+#      sibling domain must not perturb the survivors' variant registries.
+# A second leg runs the cross-router sharded-cache suite (router kill,
+# cross-router coalescing) under the same witness.
+# Runs the real `python -m tpuserve chaos --drill host_kill` CLI; wired
+# into chaos_smoke.sh and CI next to the worker/reload/fleet drills.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): router, host agents,
+# peers, and all four workers run under witnessed locks.
+export TPUSERVE_LOCK_WITNESS=1
+
+CFG="$(mktemp /tmp/tpuserve_host_drill.XXXXXX.toml)"
+OUT="$(mktemp /tmp/tpuserve_host_drill.XXXXXX.json)"
+trap 'rm -f "$CFG" "$OUT"' EXIT
+
+cat > "$CFG" <<'EOF'
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+watchdog_interval_s = 0.2
+
+[router]
+enabled = true
+hosts = 2
+workers = 2
+retry_max = 3
+hedge_ms = 200.0
+health_interval_s = 0.2
+respawn_initial_s = 0.5
+respawn_max_s = 5.0
+host_breaker_threshold = 3
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+EOF
+
+python -m tpuserve chaos --config "$CFG" --drill host_kill \
+    --duration 14 --warmup 1 --concurrency 8 --kill-after 1 \
+    --respawn-budget 90 --min-availability 0.99 | tee "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, sys
+
+s = json.load(open(sys.argv[1]))
+kill = s["kill"]
+integ = s["integrity"]
+w = s["workers"]
+assert s["availability"] >= 0.99, f"availability {s['availability']}"
+assert kill.get("workers_killed") == 2, f"did not kill a full host: {kill}"
+assert kill.get("reabsorb_s") is not None, f"host not re-absorbed: {kill}"
+budget = s["router"]["respawn_backoff_initial_s"] + 60.0
+assert kill["reabsorb_s"] <= budget, f"reabsorb {kill['reabsorb_s']}s > {budget}s"
+assert integ["validated"] > 0, integ
+assert integ["mismatched"] == 0, f"torn/mixed responses: {integ}"
+assert w["hosts_up"] == 2 and w["healthy"] == 4, w
+assert w["host_deaths_total"] == 1 and w["deaths_total"] >= 2, w
+assert s["router"]["retries_total"] >= 1, \
+    "killing a whole host mid-load should have forced at least one retry"
+deltas = s["compile_deltas"]
+assert deltas and all(d == 0 for d in deltas.values()), \
+    f"surviving workers recompiled: {deltas}"
+print(f"host drill OK: availability {s['availability']}, "
+      f"host {kill['killed_host']} ({kill['workers_killed']} workers) "
+      f"re-absorbed in {kill['reabsorb_s']}s, "
+      f"{int(s['router']['retries_total'])} retries absorbed, "
+      f"{integ['validated']} validated responses 0 torn, "
+      f"survivor compile deltas {sorted(deltas.values())}")
+EOF
+
+echo "== cross-router sharded cache (2 routers, SO_REUSEPORT, router kill) =="
+python -m pytest tests/test_multirouter.py -q -p no:cacheprovider
+
+echo "host drill OK"

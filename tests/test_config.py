@@ -335,6 +335,57 @@ family = "resnet50"
         WorkerConfig(port_base=-1)
 
 
+def test_router_hosts_and_routers_knobs(tmp_path):
+    """[router] hosts/routers (ISSUE 13): the host failure-domain and
+    horizontal-router topology parses, defaults stay flat/single, and
+    invalid values reject at construction."""
+    from tpuserve.config import RouterConfig
+
+    p = tmp_path / "serve.toml"
+    p.write_text(
+        """
+[router]
+enabled = true
+hosts = 2
+workers = 2
+routers = 3
+host_breaker_threshold = 5
+host_breaker_cooldown_s = 0.5
+peer_sync_interval_s = 0.25
+peer_port = 9300
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.router.hosts == 2 and cfg.router.workers == 2
+    assert cfg.router.routers == 3
+    assert cfg.router.host_breaker_threshold == 5
+    assert cfg.router.host_breaker_cooldown_s == 0.5
+    assert cfg.router.peer_sync_interval_s == 0.25
+    assert cfg.router.peer_port == 9300
+
+    cfg = load_config(str(p), overrides=["router.hosts=4",
+                                         "router.routers=1"])
+    assert cfg.router.hosts == 4 and cfg.router.routers == 1
+
+    # Defaults: no host layer, one router — the PR-8 flat topology.
+    assert ServerConfig().router.hosts == 0
+    assert ServerConfig().router.routers == 1
+    with pytest.raises(ValueError, match="hosts"):
+        RouterConfig(hosts=-1)
+    with pytest.raises(ValueError, match="routers"):
+        RouterConfig(routers=0)
+    with pytest.raises(ValueError, match="host_breaker"):
+        RouterConfig(host_breaker_cooldown_s=0.0)
+    with pytest.raises(ValueError, match="peer_sync_interval_s"):
+        RouterConfig(peer_sync_interval_s=0.0)
+    with pytest.raises(ValueError, match="peer_port"):
+        RouterConfig(peer_port=-1)
+
+
 def test_trace_block(tmp_path):
     p = tmp_path / "trace.toml"
     p.write_text(

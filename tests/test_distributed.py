@@ -196,3 +196,80 @@ def test_real_initialize_single_process_subprocess():
         capture_output=True, text=True, timeout=120, env=_cpu_subprocess_env(),
     )
     assert "DIST_OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_process_info_single_host_facts():
+    """ISSUE 13 satellite: process_info() is the multi-machine seam's
+    introspection — exercised BEFORE anyone needs a pod. Single-process:
+    rank 0 of 1, local == global devices, a real platform string."""
+    from tpuserve.parallel import process_info
+
+    info = process_info()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["global_devices"] == info["local_devices"] >= 1
+    assert info["platform"] in ("cpu", "tpu", "gpu")
+
+
+def test_init_distributed_pins_only_explicit_coordinates(monkeypatch):
+    """init_distributed forwards exactly the coordinates the config pins:
+    -1 means 'let jax read the cluster environment' and must NOT be
+    passed through."""
+    import tpuserve.parallel.distributed as dist
+
+    calls = []
+    monkeypatch.setattr(dist.jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(dist.jax, "process_index", lambda: 0)
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 1)
+
+    assert dist.init_distributed(
+        DistributedConfig(coordinator_address="h:1")) is True
+    assert calls[-1] == {"coordinator_address": "h:1"}
+
+    assert dist.init_distributed(DistributedConfig(
+        coordinator_address="h:1", num_processes=4, process_id=2)) is True
+    assert calls[-1] == {"coordinator_address": "h:1",
+                         "num_processes": 4, "process_id": 2}
+
+
+def test_stats_topology_block_over_http():
+    """ISSUE 13 satellite: process_info() is wired into the server's
+    /stats as the `topology` block, so every worker behind the router tier
+    reports its process coordinates next to its serving state."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpuserve.config import ModelConfig, ServerConfig
+    from tpuserve.server import ServerState, make_app
+
+    cfg = ServerConfig(
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1],
+                            deadline_ms=2.0, dtype="float32", num_classes=10,
+                            parallelism="single")],
+        decode_threads=2, startup_canary=False)
+    state = ServerState(cfg)
+    state.build()
+    state.worker_id = 7  # what worker_main stamps behind the router tier
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            resp = await client.get("/stats")
+            assert resp.status == 200
+            topo = (await resp.json())["topology"]
+            assert topo["process_index"] == 0
+            assert topo["process_count"] == 1
+            assert topo["worker_id"] == 7
+            assert topo["distributed"] is False
+            assert topo["platform"] in ("cpu", "tpu", "gpu")
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(go())
+    finally:
+        loop.close()

@@ -1,0 +1,397 @@
+"""Horizontal router tier (ISSUE 13): consistent-hash cache sharding across
+N real router processes on one SO_REUSEPORT port.
+
+- pure units: HashRing determinism, ownership balance, and the consistent-
+  hashing property (membership churn moves only the leaving member's keys);
+- a module-scoped fleet — primary router (in-process) + 1 real peer router
+  process + 1 real worker, cache enabled — proving the acceptance
+  criteria: byte-identical re-upload through ANY router = exactly 1 worker
+  execution, N identical CONCURRENT misses through different routers = 1
+  worker execution (cross-router single-flight), owner-router kill
+  degrades to local-only with cache_peer_errors_total ticking and ZERO
+  5xx, the primary respawns the peer back into the ring, and a fleet
+  reload syncs cache generations to every router.
+
+No pytest-asyncio in the image: a module-level event loop drives
+everything explicitly (the test_router idiom).
+"""
+
+import asyncio
+import io
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from tpuserve.config import ModelConfig, RouterConfig, ServerConfig
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning")
+
+NPY = "application/x-npy"
+
+
+def npy(seed: int = 0, edge: int = 8) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.random.default_rng(seed).integers(
+        0, 255, (edge, edge, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# ---------------------------------------------------------------------------
+# HashRing units
+# ---------------------------------------------------------------------------
+
+def test_ring_deterministic_and_total():
+    from tpuserve.workerproc.peers import HashRing
+
+    ring = HashRing({0: "a", 1: "b", 2: "c"})
+    keys = [f"key{i}" for i in range(200)]
+    owners = [ring.owner(k) for k in keys]
+    assert owners == [HashRing({0: "a", 1: "b", 2: "c"}).owner(k)
+                      for k in keys]
+    assert all(o is not None and o[1] in "abc" for o in owners)
+
+
+def test_ring_balances_ownership():
+    from tpuserve.workerproc.peers import HashRing
+
+    ring = HashRing({0: "a", 1: "b", 2: "c"})
+    counts = {0: 0, 1: 0, 2: 0}
+    for i in range(3000):
+        counts[ring.owner(f"key{i}")[0]] += 1
+    # vnodes keep every member within a loose band of the fair share.
+    assert all(400 <= c <= 1800 for c in counts.values()), counts
+
+
+def test_ring_membership_churn_moves_only_leavers_keys():
+    """The consistent-hashing property the respawn story rests on: when a
+    member leaves, keys it did NOT own keep their owner — so a router
+    death never reshuffles the survivors' shards."""
+    from tpuserve.workerproc.peers import HashRing
+
+    full = HashRing({0: "a", 1: "b", 2: "c"})
+    reduced = HashRing({0: "a", 2: "c"})
+    moved = stayed = 0
+    for i in range(2000):
+        k = f"key{i}"
+        before = full.owner(k)[0]
+        after = reduced.owner(k)[0]
+        if before == 1:
+            moved += 1
+            assert after in (0, 2)
+        else:
+            assert after == before, k
+            stayed += 1
+    assert moved > 0 and stayed > 0
+
+
+def test_ring_empty_owner_none():
+    from tpuserve.workerproc.peers import HashRing
+
+    assert HashRing({}).owner("x") is None
+
+
+# ---------------------------------------------------------------------------
+# The 2-router fleet (module-scoped: primary in-process + 1 peer process)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def routers(loop):
+    import aiohttp
+    from aiohttp import web
+
+    from tpuserve.workerproc.router import (
+        RouterState,
+        bind_public_socket,
+        make_router_app,
+    )
+
+    cfg = ServerConfig(
+        decode_threads=2, startup_canary=False, drain_timeout_s=3.0,
+        watchdog_interval_s=0.2,
+        router=RouterConfig(enabled=True, workers=1, routers=2, retry_max=2,
+                            health_interval_s=0.2, unhealthy_after=2,
+                            respawn_initial_s=0.3, respawn_max_s=2.0,
+                            peer_sync_interval_s=0.2),
+        models=[ModelConfig(name="toy", family="toy", batch_buckets=[1, 2],
+                            deadline_ms=2.0, dtype="float32", num_classes=10,
+                            parallelism="single",
+                            request_timeout_ms=10_000.0, wire_size=8)],
+    )
+    cfg.cache.enabled = True
+    cfg.cache.capacity = 256
+    state = RouterState(cfg)
+    sock, port = bind_public_socket("127.0.0.1", 0)
+    state.public_addr = ("127.0.0.1", port)
+    runner = web.AppRunner(make_router_app(state), access_log=None)
+
+    async def setup():
+        await runner.setup()  # on_startup: workers + peer router + ring
+        site = web.SockSite(runner, sock)
+        await site.start()
+        return aiohttp.ClientSession()
+
+    session = loop.run_until_complete(setup())
+    base = f"http://127.0.0.1:{port}"
+
+    def run(coro):
+        return loop.run_until_complete(coro)
+
+    # Wait for the peer's public listener + complete ring before any test
+    # fires concurrent load through both routers.
+    async def settle():
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            peer = state.peer_sup.peers.get(1)
+            if peer is not None and len(state.ring.members) == 2:
+                try:
+                    async with session.get(
+                            f"{peer.peer_url}/peer/stats") as r:
+                        st = await r.json()
+                    if st["router"].get("ring", {}).get("size") == 2:
+                        return
+                except Exception:  # noqa: BLE001 — peer still booting
+                    pass
+            await asyncio.sleep(0.1)
+        raise RuntimeError("peer router never settled into the ring")
+
+    run(settle())
+    yield run, session, base, state
+
+    async def teardown():
+        await session.close()
+        await runner.cleanup()
+
+    loop.run_until_complete(teardown())
+
+
+async def _worker_requests(session, base) -> float:
+    async with session.get(f"{base}/workers/0/metrics") as r:
+        assert r.status == 200
+        text = await r.text()
+    for line in text.splitlines():
+        if line.startswith('requests_total{model="toy"}'):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _body_owned_by(state, rid: int, seeds) -> bytes:
+    cache = state.caches["toy"]
+    for seed in seeds:
+        b = npy(seed)
+        if state.ring.owner(cache.key_for(("classify", NPY, b)))[0] == rid:
+            return b
+    raise AssertionError(f"no seed in range owned by router {rid}")
+
+
+def test_two_routers_serve_one_port(routers):
+    run, session, base, state = routers
+
+    async def go():
+        assert len(state.ring.members) == 2
+        async with session.post(f"{base}/v1/models/toy:classify",
+                                data=npy(1),
+                                headers={"Content-Type": NPY}) as r:
+            assert r.status == 200, await r.text()
+        async with session.get(f"{base}/healthz") as r:
+            health = await r.json()
+        assert health["status"] == "ok", health
+        assert health["routers"]["in_ring"] == 2
+        # The peer really is a separate router process with its own view.
+        peer = state.peer_sup.peers[1]
+        async with session.get(f"{peer.peer_url}/peer/stats") as r:
+            pstats = await r.json()
+        assert pstats["router"]["router_id"] == 1
+        assert pstats["router"]["is_primary"] is False
+        assert pstats["workers"]["view"] == "peer"
+        assert pstats["workers"]["healthy"] == 1
+
+    run(go())
+
+
+def test_reupload_through_any_router_single_execution(routers):
+    """Acceptance: byte-identical re-upload through ANY of N routers =
+    exactly 1 worker execution. The primary's dispatch FORWARDS a
+    peer-owned key (cache_peer_hops ticks — deterministic, driven through
+    the in-process dispatch), the peer's shard holds the one entry, and
+    every later upload of the same bytes — whichever router the shared
+    port hands it to — hits that entry."""
+    from tpuserve.workerproc.router import _dispatch
+
+    run, session, base, state = routers
+
+    async def go():
+        body = _body_owned_by(state, 1, range(1000, 1100))
+        deadline_at = time.perf_counter() + 10.0
+        before = await _worker_requests(session, base)
+        hops_before = state.handles["toy"].peer_hops.value
+
+        # First touch THROUGH THE PRIMARY: not the owner -> must forward.
+        ans = await _dispatch(state, "toy", "classify", body, NPY,
+                              deadline_at)
+        assert ans.status == 200
+        assert state.handles["toy"].peer_hops.value == hops_before + 1
+
+        # Re-uploads through the shared public port (kernel picks the
+        # router) and through the primary again: all hits, same bytes.
+        answers = {ans.body}
+        for _ in range(2):
+            async with session.post(f"{base}/v1/models/toy:classify",
+                                    data=body,
+                                    headers={"Content-Type": NPY}) as r:
+                assert r.status == 200, await r.text()
+                answers.add(await r.read())
+        ans2 = await _dispatch(state, "toy", "classify", body, NPY,
+                               time.perf_counter() + 10.0)
+        answers.add(ans2.body)
+        assert len(answers) == 1  # byte-identical everywhere
+        after = await _worker_requests(session, base)
+        assert after - before == 1, \
+            (before, after, "re-upload reached a worker twice")
+
+    run(go())
+
+
+def test_concurrent_misses_across_routers_coalesce(routers):
+    """Acceptance: N identical CONCURRENT misses through different routers
+    = 1 worker execution — the owner's single-flight leads for the whole
+    tier. Two misses enter through the primary's dispatch (forwarded to
+    the owner), two through the shared public port."""
+    from tpuserve.workerproc.router import _dispatch
+
+    run, session, base, state = routers
+
+    async def go():
+        body = _body_owned_by(state, 1, range(2000, 2100))
+        before = await _worker_requests(session, base)
+
+        async def post():
+            async with session.post(f"{base}/v1/models/toy:classify",
+                                    data=body,
+                                    headers={"Content-Type": NPY}) as r:
+                assert r.status == 200
+                return await r.read()
+
+        async def through_primary():
+            ans = await _dispatch(state, "toy", "classify", body, NPY,
+                                  time.perf_counter() + 10.0)
+            assert ans.status == 200
+            return ans.body
+
+        results = await asyncio.gather(
+            through_primary(), post(), through_primary(), post())
+        assert len(set(results)) == 1
+        after = await _worker_requests(session, base)
+        assert after - before == 1, (before, after)
+
+    run(go())
+
+
+def test_owner_kill_degrades_local_only_zero_5xx(routers):
+    """Acceptance: owner-router kill mid-flight degrades to local with
+    cache_peer_errors_total ticking and zero 5xx — then the primary
+    respawns the peer back into the ring and forwards resume."""
+    from tpuserve.workerproc.router import _dispatch
+
+    run, session, base, state = routers
+
+    async def go():
+        peer = state.peer_sup.peers[1]
+        errs_before = state.handles["toy"].peer_errors.value
+        os.kill(peer.pid, signal.SIGKILL)
+
+        # Peer-owned keys through the primary's dispatch while the ring
+        # still names the corpse: every forward fails transport, DEGRADES
+        # to the primary's local shard, and answers 200 — zero 5xx
+        # attributable to the peer hop, failures counted not surfaced.
+        served = 0
+        for seed in range(4000, 4400):
+            body = npy(seed)
+            key = state.caches["toy"].key_for(("classify", NPY, body))
+            owner = state.ring.owner(key)
+            if owner is None or owner[0] != 1:
+                continue  # ring may already have healed: stop the leg
+            ans = await _dispatch(state, "toy", "classify", body, NPY,
+                                  time.perf_counter() + 10.0)
+            assert ans.status == 200, (seed, ans.status, ans.body)
+            served += 1
+            if served >= 8:
+                break
+        if served:  # the watchdog may drop the corpse from the ring fast
+            assert state.handles["toy"].peer_errors.value > errs_before
+        # end to end through the shared port as well: no 5xx ever
+        for seed in range(4400, 4410):
+            async with session.post(f"{base}/v1/models/toy:classify",
+                                    data=npy(seed),
+                                    headers={"Content-Type": NPY}) as r:
+                await r.read()
+                assert r.status == 200
+
+        # supervised recovery: the peer rejoins the ring with a respawn
+        # counted, and its replacement serves peer-endpoint traffic again.
+        deadline = time.monotonic() + 60.0
+        new_peer = None
+        while time.monotonic() < deadline:
+            new_peer = state.peer_sup.peers.get(1)
+            if new_peer is not None and new_peer.pid != peer.pid \
+                    and new_peer.proc.is_alive() \
+                    and len(state.ring.members) == 2:
+                break
+            await asyncio.sleep(0.2)
+        assert new_peer is not None and new_peer.pid != peer.pid
+        assert len(state.ring.members) == 2
+        assert state.metrics.counter(
+            'router_respawns_total{router=1}').value >= 1
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                async with session.get(
+                        f"{new_peer.peer_url}/peer/healthz") as r:
+                    if r.status == 200:
+                        break
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+            await asyncio.sleep(0.2)
+        async with session.post(
+                f"{new_peer.peer_url}/peer/models/toy:classify",
+                data=npy(1), headers={"Content-Type": NPY}) as r:
+            assert r.status == 200, await r.text()
+
+    run(go())
+
+
+def test_reload_syncs_generations_to_every_router(routers):
+    """A fleet :reload through the shared port bumps the cache generation
+    on EVERY router (push + poll), so no router can serve a stale cached
+    answer for the old weights."""
+    run, session, base, state = routers
+
+    async def go():
+        gen_before = state.generations["toy"]
+        async with session.post(f"{base}/admin/models/toy:reload") as r:
+            info = await r.json()
+            assert r.status == 200, info
+        assert state.generations["toy"] == gen_before + 1
+        peer = state.peer_sup.peers[1]
+        deadline = time.monotonic() + 10.0
+        pgen = None
+        while time.monotonic() < deadline:
+            async with session.get(f"{peer.peer_url}/peer/stats") as r:
+                pstats = await r.json()
+            pgen = pstats["router"]["generations"]["toy"]
+            if pgen == state.generations["toy"]:
+                break
+            await asyncio.sleep(0.2)
+        assert pgen == state.generations["toy"], (pgen, state.generations)
+
+    run(go())

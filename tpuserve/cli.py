@@ -147,14 +147,22 @@ def main(argv: list[str] | None = None) -> int:
                          help="open-loop offered rate (req/s); default closed loop")
     p_chaos.add_argument("--min-availability", type=float, default=0.0,
                          help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
-    p_chaos.add_argument("--drill", choices=["reload", "worker_kill", "fleet"],
+    p_chaos.add_argument("--drill",
+                         choices=["reload", "worker_kill", "host_kill",
+                                  "fleet"],
                          default=None,
                          help="additionally drive a drill during the run: "
                               "'reload' POSTs :reload on an interval so "
                               "reload_* fault rules prove the lifecycle "
                               "gates hold availability; 'worker_kill' "
                               "serves a real router + worker fleet and "
-                              "SIGKILLs one worker mid-load; 'fleet' loads "
+                              "SIGKILLs one worker mid-load; 'host_kill' "
+                              "serves >= 2 host failure domains x >= 2 "
+                              "workers and SIGKILLs one ENTIRE host's "
+                              "process group mid-load (agent + workers — "
+                              "a machine death), gating availability on "
+                              "the survivors plus a torn/duplicate audit "
+                              "and the re-absorb time; 'fleet' loads "
                               "every configured model (>= 3), poisons "
                               "--model with device_error @ 100%, and "
                               "reports per-model isolation — the victim's "
@@ -232,6 +240,16 @@ def main(argv: list[str] | None = None) -> int:
                 cfg, model, duration_s=args.duration, warmup_s=args.warmup,
                 concurrency=args.concurrency, kill_after_s=args.kill_after,
                 respawn_budget_s=args.respawn_budget))
+        elif args.drill == "host_kill":
+            # Host-domain drill (ISSUE 13): SIGKILL one entire host's
+            # process group (agent + its workers) mid-load; the surviving
+            # hosts must hold availability while the dead domain respawns.
+            from tpuserve.workerproc.drill import run_host_kill_drill
+
+            summary = asyncio.run(run_host_kill_drill(
+                cfg, model, duration_s=args.duration, warmup_s=args.warmup,
+                concurrency=args.concurrency, kill_after_s=args.kill_after,
+                reabsorb_budget_s=args.respawn_budget))
         elif args.drill == "fleet":
             # Isolation drill (Clipper P1): --model names the VICTIM; the
             # gated availability is the WORST SURVIVOR's.

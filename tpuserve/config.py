@@ -419,7 +419,38 @@ class RouterConfig:
 
     enabled: bool = False
     # Worker processes to supervise (each builds every configured model).
+    # With hosts > 0 this is the worker count PER HOST.
     workers: int = 2
+    # Host failure domains (ISSUE 13, docs/ROBUSTNESS.md "Host failure
+    # domains"). 0 = no host layer: workers are direct children of the
+    # router (the PR-8 flat supervisor). N >= 1 groups the workers into N
+    # named hosts — locally each host is a supervisor subprocess in its own
+    # process group owning `workers` worker processes, so one SIGKILL of
+    # the group takes out the entire failure domain exactly like a machine
+    # dying. The router routes around a dead host (host breaker + health
+    # probes), respawns it with the same exponential backoff as workers,
+    # and never places a hedge on its primary's host.
+    hosts: int = 0
+    # Router processes sharing the serving port via SO_REUSEPORT. Router 0
+    # (the primary) owns the host/worker supervisor and supervises the
+    # N - 1 peer routers; every router shards the result cache by
+    # consistent hash, forwarding hits and single-flight leadership to the
+    # key's owning router over loopback HTTP and degrading to local-only
+    # (counted, never erroring) when the owner is unreachable.
+    routers: int = 1
+    # Consecutive relay transport failures (connection refused/reset)
+    # against one host's workers before the whole host is routed around
+    # without waiting for health probes; 0 disables the host breaker.
+    host_breaker_threshold: int = 3
+    # How long a tripped host breaker sheds picks before half-opening
+    # (the next pick is the recovery probe; success closes it).
+    host_breaker_cooldown_s: float = 1.0
+    # Peer routers poll the primary for topology (worker addresses, ring
+    # membership, cache generations) this often.
+    peer_sync_interval_s: float = 0.5
+    # Primary's peer-listener bind port (the loopback control plane the
+    # peer routers sync from and forward cache hops to); 0 = ephemeral.
+    peer_port: int = 0
     # Transport-failure re-dispatches per request (connection refused/reset,
     # a worker dying mid-request). Definitive worker answers (any HTTP
     # status from a live worker except 503-not-admitted) are NEVER retried:
@@ -459,6 +490,20 @@ class RouterConfig:
         if self.health_interval_s <= 0 or self.unhealthy_after < 1:
             raise ValueError(
                 "router.health_interval_s must be > 0 and unhealthy_after >= 1")
+        if self.hosts < 0:
+            raise ValueError(f"router.hosts must be >= 0, got {self.hosts}")
+        if self.routers < 1:
+            raise ValueError(
+                f"router.routers must be >= 1, got {self.routers}")
+        if self.host_breaker_threshold < 0 \
+                or self.host_breaker_cooldown_s <= 0:
+            raise ValueError(
+                "router.host_breaker_threshold must be >= 0 and "
+                "host_breaker_cooldown_s > 0")
+        if self.peer_sync_interval_s <= 0 or self.peer_port < 0:
+            raise ValueError(
+                "router.peer_sync_interval_s must be > 0 and "
+                "peer_port >= 0")
 
 
 @dataclass

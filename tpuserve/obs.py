@@ -657,6 +657,43 @@ class Metrics:
         pick from it)."""
         return self.gauge(f"worker_inflight{{worker={worker}}}")
 
+    def host_up_gauge(self, host: int) -> Gauge:
+        """host_up{host=}: 1 while the host agent process (one whole
+        failure domain: agent + its worker fleet) is alive
+        (tpuserve.workerproc.hosts). sum(host_up) is the live failure-
+        domain count; one at 0 with the rest at 1 is graceful degradation
+        working. Prebound at supervisor construction."""
+        return self.gauge(f"host_up{{host={host}}}")
+
+    def host_respawns_counter(self, host: int) -> Counter:
+        """host_respawns_total{host=}: times the router respawned this
+        entire host (agent + workers) after the agent process died —
+        the machine-level twin of worker_respawns_total."""
+        return self.counter(f"host_respawns_total{{host={host}}}")
+
+    def host_backoff_gauge(self, host: int) -> Gauge:
+        """host_backoff_s{host=}: exponential respawn delay applied to the
+        host slot's latest respawn (0 once the domain is back up)."""
+        return self.gauge(f"host_backoff_s{{host={host}}}")
+
+    def host_breaker_gauge(self, host: int) -> Gauge:
+        """host_breaker_open{host=}: 1 while consecutive relay transport
+        failures have tripped the host breaker and picks shed around the
+        whole domain (tpuserve.workerproc.hosts); 0 when closed."""
+        return self.gauge(f"host_breaker_open{{host={host}}}")
+
+    def router_up_gauge(self, router: int) -> Gauge:
+        """router_up{router=}: 1 while the supervised peer router process
+        is alive and in the consistent-hash ring
+        (tpuserve.workerproc.peers). Emitted by the PRIMARY router."""
+        return self.gauge(f"router_up{{router={router}}}")
+
+    def router_respawns_counter(self, router: int) -> Counter:
+        """router_respawns_total{router=}: times the primary respawned a
+        dead peer router process (its cache shard rejoins the ring on
+        boot)."""
+        return self.counter(f"router_respawns_total{{router={router}}}")
+
     def queue_wait_histogram(self, model: str, priority: str) -> Histogram:
         """queue_wait_ms{model=,priority=}: time a request spent queued
         before its batch flushed (or its generation slot admitted), split

@@ -53,10 +53,18 @@ def init_distributed(cfg: DistributedConfig) -> bool:
 
 
 def process_info() -> dict:
-    """Rank/topology facts for logs and the /stats endpoint."""
+    """Rank/topology facts for logs and the /stats ``topology`` block.
+
+    ``process_index``/``process_count`` are this process's coordinates in
+    the jax.distributed cluster (0/1 single-host); the device counts split
+    what this process can SEE (global) from what it OWNS (local). Behind
+    the router tier every worker serves this from its own /stats, so the
+    host-domain layout and the device topology are inspectable side by
+    side (ISSUE 13)."""
     return {
         "process_index": jax.process_index(),
         "process_count": jax.process_count(),
         "global_devices": len(jax.devices()),
         "local_devices": len(jax.local_devices()),
+        "platform": jax.devices()[0].platform,
     }

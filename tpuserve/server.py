@@ -1013,7 +1013,16 @@ async def handle_stats(request: web.Request) -> web.Response:
 
     state: ServerState = request.app[STATE_KEY]
     out = state.metrics.summary()
-    out["process"] = process_info()
+    # Topology block (ISSUE 13 satellite): the multi-machine seam's
+    # process coordinates (tpuserve.parallel.distributed.process_info —
+    # rank/host facts once jax.distributed runs under a coordinator) plus
+    # this process's place in the router tier when it serves as a worker.
+    # This is what a future multi-machine `[router] hosts` maps onto.
+    out["topology"] = {
+        **process_info(),
+        "worker_id": state.worker_id,
+        "distributed": bool(state.cfg.distributed.coordinator_address),
+    }
     # Shed/breaker state for operators (docs/ROBUSTNESS.md): what is tripped,
     # what is draining, and what chaos is armed.
     out["robustness"] = {

@@ -19,20 +19,35 @@ down with it. This package splits the deployment into **failure domains**
   circuit breakers; relays requests to the least-loaded healthy worker with
   transport-failure retry and tail-latency hedging, never past a request's
   absolute deadline.
-- ``drill``    — the ``python -m tpuserve chaos --drill worker_kill``
-  backend: SIGKILL a worker under closed-loop load and measure that
-  availability holds, the supervisor respawns within its backoff budget,
-  and no response is torn or duplicated (PAPERS.md P6).
+- ``hosts``    — host failure domains (ISSUE 13): workers grouped into
+  named hosts, each locally a supervisor subprocess in its own process
+  group (one ``killpg`` = one machine death), with host breakers,
+  host-aware hedging, and whole-domain respawn.
+- ``peers``    — the horizontal router tier (ISSUE 13): N router
+  processes on one SO_REUSEPORT port sharing a consistent-hash-sharded
+  result cache; peers forward hits/single-flight leadership to each key's
+  owning router and degrade to local-only when it dies.
+- ``drill``    — the ``python -m tpuserve chaos --drill worker_kill`` and
+  ``--drill host_kill`` backends: SIGKILL a worker (or an entire host's
+  process group) under closed-loop load and measure that availability
+  holds, the supervisor respawns within its backoff budget, and no
+  response is torn or duplicated (PAPERS.md P6).
 
 Enable with ``[router] enabled = true``; the default single-process path is
-untouched.
+untouched. ``[router] hosts`` and ``[router] routers`` grow the failure
+domains outward (docs/ROBUSTNESS.md "Host failure domains").
 """
 
+from tpuserve.workerproc.hosts import HostSupervisor
+from tpuserve.workerproc.peers import HashRing, PeerRouterSupervisor
 from tpuserve.workerproc.router import RouterState, make_router_app, serve_router
 from tpuserve.workerproc.supervisor import WorkerHandle, WorkerSupervisor
 from tpuserve.workerproc.worker import worker_main
 
 __all__ = [
+    "HashRing",
+    "HostSupervisor",
+    "PeerRouterSupervisor",
     "RouterState",
     "WorkerHandle",
     "WorkerSupervisor",

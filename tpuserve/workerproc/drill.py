@@ -59,6 +59,169 @@ async def _validator(url: str, payload: bytes, ctype: str, ref: bytes,
             await asyncio.sleep(0.01)
 
 
+async def _worker_compile_totals(urls: dict[int, str]) -> dict[int, float]:
+    """Sum runtime_compiles_total across models per worker, straight off
+    each worker's own /metrics (the drill shares the router's process, so
+    the loopback worker addresses are reachable)."""
+    import aiohttp
+
+    out: dict[int, float] = {}
+    async with aiohttp.ClientSession() as session:
+        for wid, url in urls.items():
+            try:
+                async with session.get(
+                        f"{url}/metrics",
+                        timeout=aiohttp.ClientTimeout(total=5.0)) as r:
+                    text = await r.text()
+            except Exception:  # noqa: BLE001 — dead worker: no snapshot
+                continue
+            total = 0.0
+            for line in text.splitlines():
+                if line.startswith("runtime_compiles_total"):
+                    try:
+                        total += float(line.rsplit(" ", 1)[1])
+                    except ValueError:
+                        pass
+            out[wid] = total
+    return out
+
+
+async def run_host_kill_drill(cfg: ServerConfig, model_name: str | None = None,
+                              duration_s: float = 25.0, warmup_s: float = 1.0,
+                              concurrency: int = 16,
+                              kill_after_s: float | None = None,
+                              reabsorb_budget_s: float = 120.0) -> dict:
+    """Kill-a-host chaos drill (ISSUE 13; the tentpole drill): serve a
+    router over >= 2 host failure domains x >= 2 workers each, SIGKILL one
+    ENTIRE host's process group mid-load (agent + every worker — one
+    syscall, exactly a machine losing power), and measure:
+
+    - **availability** over the whole run (survivor hosts absorb retries);
+    - **reabsorb_s** — SIGKILL until the host slot is respawned with every
+      worker healthy again (backoff + agent boot + worker boots);
+    - **torn/duplicate audit** — the worker_kill validator, byte-comparing
+      every 200 against a pre-kill reference throughout;
+    - **compile_deltas** — surviving workers' runtime_compiles_total must
+      not move (the kill must not perturb the survivors' variant
+      registries).
+    """
+    from aiohttp import web
+
+    from tpuserve.bench.loadgen import run_load, synthetic_image_npy
+    from tpuserve.workerproc.router import RouterState, make_router_app
+
+    cfg.router.enabled = True
+    cfg.router.hosts = max(2, cfg.router.hosts)
+    cfg.router.workers = max(2, cfg.router.workers)  # per host
+    # Every validated response must be a real execution: a cache would
+    # happily serve perfect answers from a fleet of corpses.
+    cfg.cache.enabled = False
+    model = model_name or cfg.models[0].name
+
+    state = RouterState(cfg)
+    app = make_router_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()  # on_startup spawns hosts + workers
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    url = f"http://127.0.0.1:{port}/v1/models/{model}:predict"
+    payload = synthetic_image_npy(edge=cfg.model(model).wire_size)
+    ctype = "application/x-npy"
+
+    kill_info: dict = {}
+    integrity = {"validated": 0, "mismatched": 0, "transport_errors": 0}
+    stop_validator = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    async def _reference() -> bytes:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, data=payload,
+                              headers={"Content-Type": ctype}) as r:
+                body = await r.read()
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"reference request failed: {r.status} {body[:200]}")
+                return body
+
+    async def _killer(survivor_urls: dict[int, str]) -> None:
+        await asyncio.sleep(warmup_s + (kill_after_s
+                                        if kill_after_s is not None
+                                        else duration_s * 0.25))
+        victim_ref = state.supervisor.pick()
+        if victim_ref is None:
+            kill_info["error"] = "no healthy worker whose host to kill"
+            return
+        hid = victim_ref.host
+        h = state.supervisor.hosts[hid]
+        if h is None:
+            kill_info["error"] = f"host {hid} already down"
+            return
+        pgid, old_pids = h.pgid, {r.wid: r.pid for r in h.workers.values()}
+        # Only NON-victim workers count for the compile-delta audit.
+        for wid in list(survivor_urls):
+            if wid in old_pids:
+                del survivor_urls[wid]
+        log.warning("drill: SIGKILL host %d — killpg(%d) takes the agent "
+                    "and workers %s at once", hid, pgid, sorted(old_pids))
+        t0 = time.monotonic()
+        os.killpg(pgid, signal.SIGKILL)
+        kill_info.update(killed_host=hid, killed_pgid=pgid,
+                         workers_killed=len(old_pids))
+        deadline = t0 + reabsorb_budget_s
+        while time.monotonic() < deadline:
+            nh = state.supervisor.hosts[hid]
+            if nh is not None and nh.pgid != pgid and nh.proc.is_alive():
+                refs = list(nh.workers.values())
+                if len(refs) == cfg.router.workers \
+                        and all(r.up and r.healthy for r in refs):
+                    kill_info["reabsorb_s"] = round(time.monotonic() - t0, 2)
+                    return
+            await asyncio.sleep(0.05)
+        kill_info["reabsorb_s"] = None  # did not come back in budget
+
+    try:
+        ref = await _reference()
+        survivor_urls = {w.wid: w.base_url
+                         for w in state.supervisor.live_workers()}
+        compiles_before = await _worker_compile_totals(dict(survivor_urls))
+        validator_task = loop.create_task(
+            _validator(url, payload, ctype, ref, stop_validator, integrity))
+        load_task = loop.create_task(
+            run_load(url, payload, ctype, duration_s, concurrency, warmup_s))
+        kill_task = loop.create_task(_killer(survivor_urls))
+        result = await load_task
+        await kill_task
+        stop_validator.set()
+        await validator_task
+        compiles_after = await _worker_compile_totals(survivor_urls)
+        workers = state.supervisor.stats()
+    finally:
+        await runner.cleanup()  # on_cleanup -> state.stop() -> fleet drain
+
+    out = result.summary()
+    total = result.n_ok + result.n_err
+    out["availability"] = round(result.n_ok / total, 5) if total else 0.0
+    out["drill"] = "host_kill"
+    out["kill"] = kill_info
+    out["integrity"] = integrity
+    out["workers"] = workers
+    out["compile_deltas"] = {
+        str(wid): compiles_after.get(wid, compiles_before[wid])
+        - compiles_before[wid]
+        for wid in compiles_before if wid in compiles_after}
+    out["router"] = {
+        "retries_total": state.handles[model].retries.value,
+        "hedges_total": state.handles[model].hedges.value,
+        "reabsorb_budget_s": reabsorb_budget_s,
+        "respawn_backoff_initial_s": cfg.router.respawn_initial_s,
+        "host_breaker_threshold": cfg.router.host_breaker_threshold,
+    }
+    return out
+
+
 async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None,
                                 duration_s: float = 20.0, warmup_s: float = 1.0,
                                 concurrency: int = 16,
@@ -75,6 +238,8 @@ async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None
 
     cfg.router.enabled = True
     cfg.router.workers = max(2, cfg.router.workers)
+    cfg.router.hosts = 0  # worker-level drill: flat supervisor (PR 8);
+    # host-level failure domains have their own drill (host_kill).
     # Every validated response must be a real execution: a cache would
     # happily serve perfect answers from a fleet of corpses.
     cfg.cache.enabled = False

@@ -1,0 +1,600 @@
+"""Horizontal router tier (ISSUE 13): N router processes, one port, one
+consistent-hash-sharded result cache.
+
+One router process is one SIGKILL away from zero availability no matter how
+many workers it fronts. This module makes the router tier itself horizontal:
+
+- **SO_REUSEPORT fan-in** — every router binds the SAME serving port with
+  ``SO_REUSEPORT`` (PR 11's listener machinery one tier up); the kernel
+  spreads connections, an external LB needs exactly one address, and a dead
+  router just stops receiving new connections while its siblings keep
+  serving.
+- **Consistent-hash cache sharding** (``HashRing``) — every cache key has
+  ONE owning router. A router holding a miss for a key it doesn't own
+  forwards the whole request to the owner's peer listener over loopback
+  HTTP, so the owner's cache + single-flight lead the computation: N
+  identical concurrent misses through N different routers still cost ONE
+  worker execution, and a byte-identical re-upload hits no matter which
+  router the kernel handed it to. When the owner is unreachable the hop
+  **degrades to local-only** — counted in ``cache_peer_errors_total``,
+  never surfaced as an error — so a router death costs shard locality, not
+  availability.
+- **Peer supervision** — router 0 (the primary) owns the worker/host
+  supervisor and supervises the peer router processes with the same
+  exponential respawn backoff (``router_up``/``router_respawns_total``); a
+  respawned peer re-syncs topology and rejoins the ring.
+- **Topology sync** (``TopologyClient``) — peers poll the primary's
+  ``/peer/state`` for worker addresses, ring membership, and cache
+  generations; a fleet reload additionally pushes an invalidation to every
+  live peer so no router serves a stale generation for longer than one
+  sync interval even if the push is lost.
+
+Ownership: every structure here is mutated on its router's event loop only
+(blocking spawns/pipe reads run on executors) — no lock to witness. The
+hash ring itself is immutable once built; membership changes build a new
+one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import hashlib
+import logging
+import multiprocessing as mp
+import os
+import time
+
+from tpuserve.config import ServerConfig
+from tpuserve.obs import Metrics
+from tpuserve.workerproc.hosts import WorkerRef
+
+log = logging.getLogger("tpuserve.workerproc")
+
+_VNODES = 64
+
+
+def _point(data: str) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data.encode(), digest_size=8).digest(), "big")
+
+
+class HashRing:
+    """Consistent-hash ring over router ids. ``vnodes`` virtual points per
+    member keep the key space balanced; membership changes move only the
+    keys adjacent to the joining/leaving member's points (the property that
+    makes a router respawn cheap: the survivors' shards stay put)."""
+
+    def __init__(self, members: dict[int, str], vnodes: int = _VNODES) -> None:
+        self.members = dict(members)
+        self._points: list[tuple[int, int]] = sorted(
+            (_point(f"router{rid}:{v}"), rid)
+            for rid in self.members for v in range(vnodes))
+
+    def owner(self, key: str) -> tuple[int, str] | None:
+        """(router id, peer url) owning ``key``, or None on an empty ring."""
+        if not self._points:
+            return None
+        h = _point(key)
+        i = bisect.bisect_left(self._points, (h, -1)) % len(self._points)
+        rid = self._points[i][1]
+        return rid, self.members[rid]
+
+
+# ---------------------------------------------------------------------------
+# Peer-side worker view (synced from the primary)
+# ---------------------------------------------------------------------------
+
+class PassiveWorkerView:
+    """A peer router's view of the worker fleet: addresses + health synced
+    from the primary's ``/peer/state``, refined by locally observed
+    transport failures. Exposes the same routing surface as the real
+    supervisors but owns no process — the primary does the supervising."""
+
+    def __init__(self, cfg: ServerConfig, metrics: Metrics) -> None:
+        self.cfg = cfg
+        self.rcfg = cfg.router
+        self.metrics = metrics
+        self.n = cfg.router.workers * (cfg.router.hosts or 1)
+        self._refs: dict[int, WorkerRef] = {}
+        self._local_bad: set[int] = set()
+        self._pick_seq = 0
+        self.deaths_total = 0
+        self.synced_at = 0.0
+
+    def update(self, rows: list[dict]) -> None:
+        """Apply one topology snapshot. Locally observed badness is wiped:
+        the primary's health probes are the authority, and a snapshot is at
+        most one sync interval old."""
+        seen = set()
+        for row in rows:
+            wid = int(row["wid"])
+            seen.add(wid)
+            ref = self._refs.get(wid)
+            if ref is None or ref.base_url != row["url"]:
+                ref = WorkerRef(wid, row.get("host"), 0, int(row.get("pid", 0)),
+                                "127.0.0.1")
+                ref.base_url = row["url"]
+                self._refs[wid] = ref
+            ref.up = True
+            ref.healthy = bool(row.get("healthy", True))
+        for wid, ref in self._refs.items():
+            if wid not in seen:
+                ref.up = False
+                ref.healthy = False
+        self._local_bad.clear()
+        self.synced_at = time.monotonic()
+
+    # -- routing surface -----------------------------------------------------
+    def healthy_workers(self) -> list[WorkerRef]:
+        return [r for r in self._refs.values() if r.up and r.healthy]
+
+    def live_workers(self) -> list[WorkerRef]:
+        return [r for r in self._refs.values() if r.up]
+
+    def worker_by_id(self, wid: int) -> WorkerRef | None:
+        ref = self._refs.get(wid)
+        return ref if ref is not None and ref.up else None
+
+    def host_of(self, ref) -> int | None:
+        return getattr(ref, "host", None)
+
+    def down_domains(self) -> list[str]:
+        return []  # admin fan-outs run on the primary, never here
+
+    def note_transport_failure(self, ref) -> None:
+        """Mark a worker locally bad until the next topology sync — don't
+        keep relaying at a corpse for the rest of the sync interval."""
+        ref.healthy = False
+        self._local_bad.add(ref.wid)
+
+    def note_success(self, ref) -> None:
+        if ref.wid in self._local_bad:
+            self._local_bad.discard(ref.wid)
+            ref.healthy = True
+
+    def pick(self, exclude: set[int] = frozenset(),
+             exclude_hosts: set[int] = frozenset()) -> WorkerRef | None:
+        best: WorkerRef | None = None
+        for ref in self._refs.values():
+            if not ref.up or not ref.healthy or ref.wid in exclude:
+                continue
+            if ref.host is not None and ref.host in exclude_hosts:
+                continue
+            if best is None \
+                    or (ref.inflight, ref.picked_seq) < (best.inflight,
+                                                         best.picked_seq):
+                best = ref
+        if best is not None:
+            self._pick_seq += 1
+            best.picked_seq = self._pick_seq
+        return best
+
+    def track_inflight(self, ref: WorkerRef, delta: int) -> None:
+        ref.inflight += delta
+
+    def respawn_eta_s(self) -> float:
+        return self.rcfg.health_interval_s
+
+    def sweep(self) -> int:
+        return 0
+
+    def stats(self) -> dict:
+        return {
+            "configured": self.n,
+            "healthy": len(self.healthy_workers()),
+            "deaths_total": self.deaths_total,
+            "view": "peer",
+            "synced_age_s": round(time.monotonic() - self.synced_at, 3)
+            if self.synced_at else None,
+            "workers": [{
+                "worker": r.wid, "host": r.host,
+                "state": ("ready" if r.healthy else "unhealthy") if r.up
+                else "down",
+                "inflight": r.inflight,
+            } for r in sorted(self._refs.values(), key=lambda r: r.wid)],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Topology sync (peer side)
+# ---------------------------------------------------------------------------
+
+class TopologyClient:
+    """Polls the primary's ``/peer/state`` and applies it to a peer
+    RouterState (worker view, hash ring, cache generations)."""
+
+    def __init__(self, state, primary_peer_url: str,
+                 interval_s: float) -> None:
+        self.state = state
+        self.url = primary_peer_url.rstrip("/")
+        self.interval_s = interval_s
+        self._task: asyncio.Task | None = None
+        self._c_errors = state.metrics.counter("peer_sync_errors_total")
+        self._c_syncs = state.metrics.counter("peer_syncs_total")
+
+    async def start(self, boot_timeout_s: float = 30.0) -> None:
+        """Boot sync, then the poll task. Called AFTER the ready handshake:
+        the sync is retried until the observed ring is COMPLETE — contains
+        this router and all ``[router] routers`` members — so a peer never
+        opens its public listener with a ring that would mis-shard keys
+        (the primary adopts peers as their handshakes land; a sibling still
+        booting keeps the ring short for a moment). On timeout with ANY
+        topology, proceed degraded — the poll loop heals membership; with
+        none at all, raise (the primary respawns us)."""
+        state = self.state
+        want = state.rcfg.routers
+        deadline = time.monotonic() + boot_timeout_s
+        while True:
+            try:
+                await self.sync()
+                ring = state.ring
+                if ring is not None and state.router_id in ring.members \
+                        and len(ring.members) >= want:
+                    break
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — primary not up yet
+                pass
+            if time.monotonic() >= deadline:
+                if state.ring is None:
+                    raise RuntimeError(
+                        f"router {state.router_id}: no topology from "
+                        f"{self.url} within {boot_timeout_s:.0f}s")
+                log.warning("router %d: boot ring incomplete (%d/%d "
+                            "members); serving degraded until the poll "
+                            "sync heals it", state.router_id,
+                            len(state.ring.members), want)
+                break
+            await asyncio.sleep(0.1)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.sync()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — keep last-known topology
+                self._c_errors.inc()
+
+    async def sync(self) -> None:
+        import aiohttp
+
+        async with self.state._session.get(
+                f"{self.url}/peer/state",
+                timeout=aiohttp.ClientTimeout(total=2.0)) as r:
+            if r.status != 200:
+                raise RuntimeError(f"/peer/state answered {r.status}")
+            data = await r.json()
+        self.state.apply_topology(data)
+        self._c_syncs.inc()
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+# ---------------------------------------------------------------------------
+# Peer router process + primary-side supervision
+# ---------------------------------------------------------------------------
+
+def peer_main(cfg: ServerConfig, router_id: int, public_host: str,
+              public_port: int, primary_peer_url: str, conn) -> None:
+    """Peer-router process entry (multiprocessing spawn target). Device-free
+    like every router: it builds no models, owns no workers — it binds the
+    shared public port with SO_REUSEPORT, owns its cache shard, and relays
+    to the worker addresses it syncs from the primary."""
+    from tpuserve.server import configure_logging
+
+    configure_logging(cfg)
+    log.info("peer router %d: starting (pid %d)", router_id, os.getpid())
+    try:
+        asyncio.run(_peer_serve(cfg, router_id, public_host, public_port,
+                                primary_peer_url, conn))
+    except Exception as e:  # noqa: BLE001 — report any death upward
+        try:
+            conn.send({"op": "died", "error": f"{type(e).__name__}: {e}"})
+        except (BrokenPipeError, OSError):
+            pass
+        raise
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+async def _peer_serve(cfg: ServerConfig, router_id: int, public_host: str,
+                      public_port: int, primary_peer_url: str,
+                      conn) -> None:
+    import signal as _signal
+    import socket as _socket
+
+    from aiohttp import web
+
+    from tpuserve.workerproc.router import RouterState, make_router_app
+
+    state = RouterState(cfg, router_id=router_id,
+                        primary_peer_url=primary_peer_url)
+    await state.start()  # session + peer listener (no public serving yet)
+
+    # Handshake FIRST: the primary can only add this router to the ring
+    # once it knows the peer port. Then sync until the ring is complete,
+    # and only then open the public listener — a peer never takes public
+    # traffic with a ring that would mis-shard keys.
+    conn.send({"op": "ready", "peer_port": state.peer_port,
+               "pid": os.getpid()})
+    # Peer handshakes are fast (no model builds): a ring that is still
+    # incomplete after 30s means a sibling died at boot — serve degraded
+    # and let the poll sync heal membership when it respawns.
+    await state.topo.start(
+        boot_timeout_s=min(30.0, cfg.router.spawn_timeout_s))
+
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+        sock.bind((public_host, public_port))
+    except OSError:
+        sock.close()
+        await state.stop()
+        raise
+    runner = web.AppRunner(make_router_app(state, own_lifecycle=False),
+                           access_log=None)
+    await runner.setup()
+    site = web.SockSite(runner, sock)
+    await site.start()
+    log.info("peer router %d serving on %s:%d (peer port %d, ring %s)",
+             router_id, public_host, public_port, state.peer_port,
+             sorted(state.ring.members) if state.ring else None)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+
+    async def _watch_parent() -> None:
+        # The primary vanished (pipe EOF): drain and exit rather than keep
+        # a half-fleet serving with no supervisor.
+        from tpuserve.workerproc.hosts import _EOF, _poll_recv
+
+        while True:
+            msg = await loop.run_in_executor(None, _poll_recv, conn, 0.25)
+            if msg is _EOF:
+                stop.set()
+                return
+            if msg is not None and msg.get("op") == "stop":
+                stop.set()
+                return
+
+    watcher = loop.create_task(_watch_parent())
+    try:
+        await stop.wait()
+        await state.drain()
+    finally:
+        watcher.cancel()
+        await asyncio.gather(watcher, return_exceptions=True)
+        await runner.cleanup()
+        await state.stop()
+
+
+class PeerHandle:
+    """Primary-side handle for one live peer router process."""
+
+    __slots__ = ("rid", "proc", "conn", "peer_port", "peer_url", "pid",
+                 "started_at")
+
+    def __init__(self, rid: int, proc, conn, peer_port: int,
+                 pid: int) -> None:
+        self.rid = rid
+        self.proc = proc
+        self.conn = conn
+        self.peer_port = peer_port
+        self.peer_url = f"http://127.0.0.1:{peer_port}"
+        self.pid = pid
+        self.started_at = time.monotonic()
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class PeerRouterSupervisor:
+    """Spawns and supervises the N-1 peer router processes (router 0 is
+    the caller). Same liveness-sweep + exponential-backoff respawn pattern
+    as the worker supervisor; ``on_change`` fires on every membership
+    change so the primary rebuilds its hash ring."""
+
+    def __init__(self, cfg: ServerConfig, metrics: Metrics,
+                 on_change) -> None:
+        self.cfg = cfg
+        self.rcfg = cfg.router
+        self.metrics = metrics
+        self.on_change = on_change
+        self.rids = list(range(1, cfg.router.routers))
+        self.peers: dict[int, PeerHandle] = {}
+        self._fails = {rid: 0 for rid in self.rids}
+        self._next_up_at = {rid: 0.0 for rid in self.rids}
+        self._respawning: set[int] = set()
+        self._bg: set[asyncio.Task] = set()
+        self._stopping = False
+        self.deaths_total = 0
+        self._public: tuple[str, int] | None = None
+        self._primary_peer_url: str | None = None
+        self._g_up = {rid: metrics.router_up_gauge(rid) for rid in self.rids}
+        self._c_respawns = {rid: metrics.router_respawns_counter(rid)
+                            for rid in self.rids}
+
+    async def start(self, public_host: str, public_port: int,
+                    primary_peer_url: str) -> None:
+        self._public = (public_host, public_port)
+        self._primary_peer_url = primary_peer_url
+        loop = asyncio.get_running_loop()
+        spawned = await asyncio.gather(
+            *(loop.run_in_executor(None, self._spawn_blocking, rid)
+              for rid in self.rids))
+        for h in spawned:
+            self.peers[h.rid] = h
+            self._g_up[h.rid].set(1.0)
+        if spawned:
+            self.on_change()
+        log.info("peer routers up: %s",
+                 [f"{h.rid}@{h.peer_port}" for h in spawned])
+
+    def _spawn_blocking(self, rid: int) -> PeerHandle:
+        ctx = mp.get_context("spawn")
+        parent, child = ctx.Pipe()
+        host, port = self._public
+        proc = ctx.Process(
+            target=peer_main,
+            args=(self.cfg, rid, host, port, self._primary_peer_url, child),
+            daemon=True, name=f"tpuserve-router-{rid}")
+        proc.start()
+        child.close()
+        try:
+            if not parent.poll(self.rcfg.spawn_timeout_s):
+                raise TimeoutError(
+                    f"peer router {rid} not ready after "
+                    f"{self.rcfg.spawn_timeout_s:.0f}s")
+            msg = parent.recv()
+            if msg.get("op") != "ready":
+                raise RuntimeError(f"peer router {rid} failed at boot: {msg}")
+        except BaseException:
+            if proc.is_alive():
+                proc.kill()
+            proc.join(5.0)
+            parent.close()
+            raise
+        if self._stopping:
+            proc.kill()
+            proc.join(5.0)
+            parent.close()
+            raise RuntimeError(
+                f"supervisor stopping; discarded peer router {rid}")
+        return PeerHandle(rid, proc, parent, int(msg["peer_port"]),
+                          int(msg.get("pid", proc.pid)))
+
+    def members(self) -> dict[int, str]:
+        """Live ring members among the peers (the primary adds itself)."""
+        return {rid: h.peer_url for rid, h in self.peers.items()
+                if h.proc.is_alive()}
+
+    def sweep(self) -> int:
+        """Watchdog hook: reap dead peer routers, drop them from the ring,
+        respawn with backoff."""
+        if self._stopping:
+            return 0
+        died = 0
+        for rid in list(self.peers):
+            h = self.peers[rid]
+            if not h.proc.is_alive():
+                died += 1
+                log.error("peer router %d (pid %d) died (code %s)",
+                          rid, h.pid, h.proc.exitcode)
+                self.deaths_total += 1
+                h.close()
+                del self.peers[rid]
+                self._g_up[rid].set(0.0)
+                self.on_change()
+                self._schedule_respawn(rid)
+        return died
+
+    def _schedule_respawn(self, rid: int) -> None:
+        if self._stopping or rid in self._respawning:
+            return
+        self._respawning.add(rid)
+        t = asyncio.get_running_loop().create_task(self._respawn(rid))
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
+
+    async def _respawn(self, rid: int) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while not self._stopping:
+                delay = min(self.rcfg.respawn_max_s,
+                            self.rcfg.respawn_initial_s
+                            * self.rcfg.respawn_multiplier ** self._fails[rid])
+                self._next_up_at[rid] = time.monotonic() + delay
+                await asyncio.sleep(delay)
+                if self._stopping:
+                    return
+                try:
+                    h = await loop.run_in_executor(
+                        None, self._spawn_blocking, rid)
+                except Exception:
+                    self._fails[rid] += 1
+                    log.exception("peer router %d respawn failed "
+                                  "(consecutive failures: %d)",
+                                  rid, self._fails[rid])
+                    continue
+                self.peers[rid] = h
+                self._fails[rid] = 0
+                self._g_up[rid].set(1.0)
+                self._c_respawns[rid].inc()
+                self.on_change()
+                log.info("peer router %d respawned (pid %d, peer port %d)",
+                         rid, h.pid, h.peer_port)
+                return
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self._respawning.discard(rid)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in list(self._bg):
+            t.cancel()
+        if self._bg:
+            await asyncio.gather(*self._bg, return_exceptions=True)
+        live = [h for h in self.peers.values() if h.proc.is_alive()]
+        for h in live:
+            h.proc.terminate()
+        deadline = time.monotonic() + self.cfg.drain_timeout_s + 2.0
+        while any(h.proc.is_alive() for h in live) \
+                and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        for h in live:
+            if h.proc.is_alive():
+                h.proc.kill()
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, lambda: [h.proc.join(10.0) for h in live])
+        for rid, h in list(self.peers.items()):
+            h.close()
+            self._g_up[rid].set(0.0)
+
+    def stats(self) -> dict:
+        now = time.monotonic()
+        rows = []
+        for rid in self.rids:
+            h = self.peers.get(rid)
+            if h is None or not h.proc.is_alive():
+                rows.append({
+                    "router": rid,
+                    "state": "respawning" if rid in self._respawning
+                    else "down",
+                    "respawn_eta_s": round(
+                        max(0.0, self._next_up_at[rid] - now), 3),
+                    "respawns_total": self._c_respawns[rid].value,
+                })
+            else:
+                rows.append({
+                    "router": rid, "state": "up", "pid": h.pid,
+                    "peer_port": h.peer_port,
+                    "uptime_s": round(now - h.started_at, 1),
+                    "respawns_total": self._c_respawns[rid].value,
+                })
+        return {"configured": len(self.rids) + 1,
+                "deaths_total": self.deaths_total,
+                "peers": rows}

@@ -63,6 +63,13 @@ from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, Watchdog
 from tpuserve.obs import FlightRecorder, Metrics, TraceContext, spans_to_chrome
 from tpuserve.server import _err, _requested_timeout_ms, configure_logging
+from tpuserve.workerproc.hosts import HostSupervisor, host_name
+from tpuserve.workerproc.peers import (
+    HashRing,
+    PassiveWorkerView,
+    PeerRouterSupervisor,
+    TopologyClient,
+)
 from tpuserve.workerproc.supervisor import WorkerHandle, WorkerSupervisor
 
 log = logging.getLogger("tpuserve.workerproc")
@@ -124,7 +131,7 @@ class RouterHandles:
     """Per-model hot-path metric handles, prebound once (PR 5 discipline)."""
 
     __slots__ = ("mcfg", "requests", "retries", "hedges", "timeouts",
-                 "latency")
+                 "latency", "peer_hops", "peer_errors", "peer_serves")
 
     def __init__(self, name: str, mcfg, metrics: Metrics) -> None:
         self.mcfg = mcfg
@@ -133,14 +140,33 @@ class RouterHandles:
         self.hedges = metrics.counter(f"router_hedges_total{{model={name}}}")
         self.timeouts = metrics.counter(f"router_timeouts_total{{model={name}}}")
         self.latency = metrics.histogram(f"router_latency_ms{{model={name}}}")
+        # Sharded-cache peer hops (ISSUE 13): forwards to a key's owning
+        # router, hops that failed transport (and degraded to local-only),
+        # and requests this router served on a peer's behalf.
+        self.peer_hops = metrics.counter(
+            f"cache_peer_hops_total{{model={name}}}")
+        self.peer_errors = metrics.counter(
+            f"cache_peer_errors_total{{model={name}}}")
+        self.peer_serves = metrics.counter(
+            f"cache_peer_serves_total{{model={name}}}")
 
 
 class RouterState:
-    """Everything a running router process owns."""
+    """Everything a running router process owns.
 
-    def __init__(self, cfg: ServerConfig) -> None:
+    ``router_id`` 0 (the default) is the PRIMARY: it owns the worker/host
+    supervisor and, with ``[router] routers > 1``, the peer-router
+    supervisor. Peer routers (``router_id >= 1``, spawned by the primary
+    via ``tpuserve.workerproc.peers``) own no processes — they sync the
+    worker topology and hash-ring membership from the primary's peer
+    listener and serve the same public port through SO_REUSEPORT."""
+
+    def __init__(self, cfg: ServerConfig, router_id: int = 0,
+                 primary_peer_url: str | None = None) -> None:
         self.cfg = cfg
         self.rcfg = cfg.router
+        self.router_id = router_id
+        self.is_primary = router_id == 0
         self.metrics = Metrics(cfg.trace_capacity,
                                exemplars=cfg.trace.exemplars)
         # Router-side flight recorder (ISSUE 12): retains the front door's
@@ -153,8 +179,33 @@ class RouterState:
             error_capacity=cfg.trace.error_capacity,
             always_record_errors=cfg.trace.always_record_errors,
             metrics=self.metrics)
-        self.supervisor = WorkerSupervisor(cfg, self.metrics)
+        if not self.is_primary:
+            # Peer router: a passive worker view synced from the primary.
+            self.supervisor = PassiveWorkerView(cfg, self.metrics)
+        elif cfg.router.hosts > 0:
+            # Host failure domains (ISSUE 13): workers grouped under host
+            # agents, each agent one SIGKILL-able process group.
+            self.supervisor = HostSupervisor(cfg, self.metrics)
+        else:
+            self.supervisor = WorkerSupervisor(cfg, self.metrics)
         self.watchdog = Watchdog(cfg.watchdog_interval_s, self.metrics)
+        # Horizontal router tier (ISSUE 13): the consistent-hash ring over
+        # every live router's peer listener. None until membership is known
+        # (single-router deployments keep it None: always-local).
+        self.ring: HashRing | None = None
+        self.peer_port: int | None = None
+        self.peer_url: str | None = None
+        self._peer_runner = None
+        # (host, port) of the shared public listener — the caller binds the
+        # SO_REUSEPORT socket BEFORE start() so peer routers can join it.
+        self.public_addr: tuple[str, int] | None = None
+        self.peer_sup = (PeerRouterSupervisor(cfg, self.metrics,
+                                              self._rebuild_ring)
+                         if self.is_primary and cfg.router.routers > 1
+                         else None)
+        self.topo = (TopologyClient(self, primary_peer_url,
+                                    cfg.router.peer_sync_interval_s)
+                     if not self.is_primary else None)
         self.handles: dict[str, RouterHandles] = {}
         self.breakers: dict[str, CircuitBreaker] = {}
         self.caches: dict[str, ModelCache] = {}
@@ -199,12 +250,88 @@ class RouterState:
         if witness.maybe_install():
             log.info("lock witness installed (TPUSERVE_LOCK_WITNESS)")
         self._session = aiohttp.ClientSession()
+        if not self.is_primary:
+            # Peer router: bind the peer listener (cache hops land here).
+            # The topology sync is sequenced by _peer_serve AFTER the ready
+            # handshake — the primary can only put this peer in the ring
+            # once it has learned the peer port, so syncing before the
+            # handshake would always observe a ring missing ourselves.
+            await self._start_peer_listener()
+            return
         await self.supervisor.start()
         # Process-liveness sweep rides the same Watchdog as PR 1's group
-        # loops: a reaped+respawn-scheduled worker lands in
-        # watchdog_restarts_total{model=_router,component=worker}.
-        self.watchdog.register("_router", "worker", self.supervisor.sweep)
+        # loops: a reaped+respawn-scheduled worker (or whole host) lands in
+        # watchdog_restarts_total{model=_router,component=worker|host}.
+        component = "host" if self.rcfg.hosts > 0 else "worker"
+        self.watchdog.register("_router", component, self.supervisor.sweep)
+        if self.peer_sup is not None or self.rcfg.routers > 1:
+            await self._start_peer_listener()
+        if self.peer_sup is not None:
+            if self.public_addr is None:
+                raise RuntimeError(
+                    "[router] routers > 1 needs the shared public address "
+                    "bound before start(): set state.public_addr (serve_"
+                    "router_async does this via the SO_REUSEPORT socket)")
+            await self.peer_sup.start(self.public_addr[0],
+                                      self.public_addr[1], self.peer_url)
+            self.watchdog.register("_router", "router", self.peer_sup.sweep)
+            self._rebuild_ring()
         self.watchdog.start()
+
+    async def _start_peer_listener(self) -> None:
+        """Bind this router's loopback control plane: /peer/state topology,
+        /peer/models (sharded-cache hops from sibling routers), and the
+        primary's /peer/admin fan-out entry."""
+        self._peer_runner = web.AppRunner(make_peer_app(self),
+                                          access_log=None)
+        await self._peer_runner.setup()
+        port = self.rcfg.peer_port if (self.is_primary
+                                       and self.rcfg.peer_port) else 0
+        site = web.TCPSite(self._peer_runner, "127.0.0.1", port)
+        await site.start()
+        self.peer_port = self._peer_runner.addresses[0][1]
+        self.peer_url = f"http://127.0.0.1:{self.peer_port}"
+
+    def _rebuild_ring(self) -> None:
+        """Primary: rebuild the hash ring from itself + live peers (called
+        at start and on every peer death/respawn). Peers rebuild theirs
+        from /peer/state instead."""
+        members = {self.router_id: self.peer_url}
+        if self.peer_sup is not None:
+            members.update(self.peer_sup.members())
+        self.ring = HashRing(members)
+
+    def apply_topology(self, data: dict) -> None:
+        """Peer side: adopt one /peer/state snapshot — worker addresses,
+        ring membership, and cache generations (a generation bump clears
+        the local shard, the poll-path half of reload invalidation)."""
+        self.supervisor.update(data.get("workers") or [])
+        members = {int(r["router"]): r["peer_url"]
+                   for r in (data.get("ring") or [])}
+        if members and (self.ring is None or members != self.ring.members):
+            self.ring = HashRing(members)
+        for name, gen in (data.get("generations") or {}).items():
+            gen = int(gen)
+            if name in self.generations and self.generations[name] != gen:
+                self.generations[name] = gen
+                cache = self.caches.get(name)
+                if cache is not None:
+                    cache.clear()
+
+    def peer_state(self) -> dict:
+        """The /peer/state body a peer syncs from (primary's authority)."""
+        sup = self.supervisor
+        workers = [{"wid": w.wid, "host": sup.host_of(w),
+                    "url": w.base_url, "healthy": w.healthy}
+                   for w in sup.live_workers()]
+        if self.ring is not None:
+            ring = [{"router": rid, "peer_url": url}
+                    for rid, url in sorted(self.ring.members.items())]
+        else:
+            ring = [{"router": self.router_id, "peer_url": self.peer_url}]
+        return {"ring": ring, "workers": workers,
+                "generations": dict(self.generations),
+                "draining": self.draining}
 
     def begin_drain(self) -> None:
         self.draining = True
@@ -223,9 +350,19 @@ class RouterState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
-        # Workers drain their accepted batches on SIGTERM; with the router
-        # already drained there is nothing in flight to lose.
-        await self.supervisor.stop(drain=True)
+        if self.topo is not None:
+            await self.topo.stop()
+        if self.peer_sup is not None:
+            # Peer routers first: they drain their own in-flight relays on
+            # SIGTERM, and must do so while workers still answer.
+            await self.peer_sup.stop()
+        if self.is_primary:
+            # Workers drain their accepted batches on SIGTERM; with the
+            # router already drained there is nothing in flight to lose.
+            await self.supervisor.stop(drain=True)
+        if self._peer_runner is not None:
+            await self._peer_runner.cleanup()
+            self._peer_runner = None
         if self._session is not None:
             await self._session.close()
             self._session = None
@@ -304,9 +441,21 @@ class RouterState:
         def remaining() -> float:
             return deadline_at - time.perf_counter()
 
-        def launch() -> bool:
-            w = self.supervisor.pick(exclude=tried)
-            if w is None and tried:
+        def launch(hedge: bool = False) -> bool:
+            exclude_hosts: set[int] = set()
+            if hedge:
+                # A hedge exists to cover a wedged/dying FAILURE DOMAIN:
+                # placing it beside its primary would make one host death
+                # kill both copies, so the in-flight attempts' hosts are
+                # hard-excluded (no fallback) — if every other host is
+                # busy or down, we simply don't hedge.
+                for w2 in tasks.values():
+                    hid = self.supervisor.host_of(w2)
+                    if hid is not None:
+                        exclude_hosts.add(hid)
+            w = self.supervisor.pick(exclude=tried,
+                                     exclude_hosts=exclude_hosts)
+            if w is None and tried and not hedge:
                 # Every healthy worker was already tried: allow a
                 # re-dispatch (the failure may have been transient and the
                 # fleet may be down to one survivor).
@@ -340,9 +489,11 @@ class RouterState:
                 if not done:
                     if can_hedge() and remaining() > 0:
                         # Primary silent past hedge_ms: race a duplicate on
-                        # another worker. Safe for idempotent inference;
+                        # another worker — never on the primary's host (a
+                        # hedge that shares its primary's failure domain
+                        # covers nothing). Safe for idempotent inference;
                         # first definitive answer wins below.
-                        if launch():
+                        if launch(hedge=True):
                             hedges_left -= 1
                             h.hedges.inc()
                         else:
@@ -352,12 +503,13 @@ class RouterState:
                         raise RelayDeadline()
                     continue
                 for t in done:
-                    tasks.pop(t)
+                    w_done = tasks.pop(t)
                     if t.cancelled():
                         continue
                     exc = t.exception()
                     if exc is None:
                         ans = await t  # already done: no suspension
+                        self.supervisor.note_success(w_done)
                         if ans.status != 503:
                             # Definitive: the worker admitted and answered
                             # (200, 4xx, 500, 504). NEVER re-dispatched —
@@ -373,6 +525,13 @@ class RouterState:
                         if isinstance(exc, asyncio.TimeoutError) \
                                 and remaining() <= 0:
                             raise RelayDeadline() from exc
+                        if isinstance(exc, (aiohttp.ClientConnectionError,
+                                            ConnectionError)):
+                            # Refused/reset — the "this machine just died"
+                            # signal. Feeds the host breaker so a whole
+                            # dead host is routed around in milliseconds,
+                            # not after a health-probe cycle.
+                            self.supervisor.note_transport_failure(w_done)
                         last_exc = exc
                     else:
                         raise exc  # programming error — surface it
@@ -416,10 +575,20 @@ class RouterState:
 
     # -- admin fan-out -------------------------------------------------------
     def live_workers(self) -> list[WorkerHandle]:
-        """Every slot with a live process — admin fan-outs must reach
+        """Every worker with a live process — admin fan-outs must reach
         unhealthy-but-alive workers too, or the fleet's versions diverge."""
-        return [w for w in self.supervisor.slots
-                if w is not None and w.proc.is_alive()]
+        return self.supervisor.live_workers()
+
+    def _per_host_outcomes(self, per_worker: dict) -> dict | None:
+        """Group per-worker admin outcomes by failure domain (host mode
+        only): the operator-facing view of a partial fan-out."""
+        if self.rcfg.hosts <= 0:
+            return None
+        out: dict[str, dict] = {}
+        per = self.rcfg.workers
+        for wid, row in per_worker.items():
+            out.setdefault(host_name(int(wid) // per), {})[wid] = row
+        return out
 
     async def _admin_call(self, w: WorkerHandle, method: str,
                           path: str) -> tuple[int, int, dict]:
@@ -447,6 +616,25 @@ class RouterState:
         if not workers:
             return 503, {"error": "no live worker to reload",
                          "workers": {}}
+        # Degraded-fleet gate (ISSUE 13 satellite): a dead/respawning
+        # failure domain — a whole host, or a worker its agent is still
+        # re-booting — must be a FAST partial-failure answer, not a hang
+        # and not a divergent fleet. The missing domain respawns from the
+        # BOOT config, so publishing to the survivors would leave the fleet
+        # on two versions the moment it comes back. Refuse up front with
+        # the per-domain picture; nobody is touched, one version stands.
+        down = self.supervisor.down_domains()
+        if down:
+            body = {"error": f"fleet degraded ({', '.join(down)} down/"
+                             "respawning); reload refused — a respawning "
+                             "domain boots the original config and would "
+                             "diverge from the new version",
+                    "down": down, "workers": {}}
+            per_host = self._per_host_outcomes(
+                {w.wid: {"status": "skipped"} for w in workers})
+            if per_host is not None:
+                body["per_host"] = per_host
+            return 409, body
         results = await asyncio.gather(
             *(self._admin_call(w, "POST", f"/admin/models/{name}:reload")
               for w in workers))
@@ -457,10 +645,15 @@ class RouterState:
             cache = self.caches.get(name)
             if cache is not None:
                 cache.clear()
+            await self._broadcast_generation(name)
             versions = {body.get("version") for _, _, body in results}
-            return 200, {"workers": per_worker,
-                         "version": results[0][2].get("version"),
-                         "fleet_consistent": len(versions) == 1}
+            out = {"workers": per_worker,
+                   "version": results[0][2].get("version"),
+                   "fleet_consistent": len(versions) == 1}
+            per_host = self._per_host_outcomes(per_worker)
+            if per_host is not None:
+                out["per_host"] = per_host
+            return 200, out
         # Partial failure: restore the workers that DID publish, so the
         # fleet stays on one version (all-or-nothing).
         succeeded = [w for w, (_, status, _) in zip(workers, results)
@@ -477,10 +670,37 @@ class RouterState:
         # a clean pre-publish rejection everywhere is a 409 conflict.
         any_rb = any(body.get("rolled_back") for _, _, body in results)
         status = 500 if (any_rb or succeeded) else 409
-        return status, {"error": "reload rejected by at least one worker; "
-                                 "fleet kept on one version",
-                        "workers": per_worker,
-                        "rolled_back_workers": rolled_back}
+        out = {"error": "reload rejected by at least one worker; "
+                        "fleet kept on one version",
+               "workers": per_worker,
+               "rolled_back_workers": rolled_back}
+        per_host = self._per_host_outcomes(per_worker)
+        if per_host is not None:
+            out["per_host"] = per_host
+        return status, out
+
+    async def _broadcast_generation(self, name: str) -> None:
+        """Push the bumped cache generation to every live peer router
+        (best-effort: the poll sync is the backstop, so a lost push costs
+        at most one peer_sync_interval_s of stale shard)."""
+        if self.peer_sup is None:
+            return
+        gen = self.generations.get(name, 1)
+
+        async def _push(url: str) -> None:
+            try:
+                async with self._session.post(
+                        f"{url}/peer/invalidate",
+                        json={"model": name, "generation": gen},
+                        timeout=aiohttp.ClientTimeout(total=2.0)) as r:
+                    await r.read()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — poll sync is the backstop
+                pass
+
+        await asyncio.gather(
+            *(_push(url) for url in self.peer_sup.members().values()))
 
     async def fanout_simple(self, name: str, op: str) -> tuple[int, dict]:
         """Best-effort fan-out for ``:rollback`` (every live worker must
@@ -506,6 +726,7 @@ class RouterState:
             cache = self.caches.get(name)
             if cache is not None:
                 cache.clear()
+            await self._broadcast_generation(name)
         return (200 if ok else 409), {"workers": per_worker}
 
 
@@ -633,18 +854,89 @@ async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
                     ctype: str, deadline_at: float,
                     priority: str | None = None,
                     ctx: "TraceContext | None" = None) -> _Answer:
-    """Cache/single-flight front of the relay (router-owned PR-5 layer).
+    """Cache/single-flight front of the relay (router-owned PR-5 layer),
+    sharded across the router tier (ISSUE 13).
 
     The cache key is content-addressed at the WIRE level — the router has
     no models to decode with — so byte-identical uploads hit, and the
     per-model config generation in every key makes a fleet reload an
     atomic invalidation. Priority deliberately stays OUT of the key: it
-    schedules the work, it does not change the answer."""
+    schedules the work, it does not change the answer.
+
+    With N routers, the consistent-hash ring names ONE owner per key: a
+    non-owner forwards the whole request to the owner's peer listener so
+    the owner's cache + single-flight lead — coalescing and re-upload
+    semantics hold across routers. An unreachable owner degrades to the
+    local path (counted), never to an error."""
     cache = state.caches.get(name)
     if cache is None:
         return await state._relay(name, verb, body, ctype, deadline_at,
                                   priority, ctx)
     key = cache.key_for((verb, ctype, body))
+    if state.ring is not None:
+        owner = state.ring.owner(key)
+        if owner is not None and owner[0] != state.router_id:
+            ans = await _peer_forward(state, owner, name, verb, body, ctype,
+                                      deadline_at, priority, ctx)
+            if ans is not None:
+                return ans
+            # Owner unreachable: fall through to the LOCAL cache path —
+            # shard locality is lost until the owner respawns, coalescing
+            # within this router still works, and the client sees nothing.
+    return await _dispatch_local(state, cache, key, name, verb, body, ctype,
+                                 deadline_at, priority, ctx)
+
+
+async def _peer_forward(state: RouterState, owner: tuple[int, str],
+                        name: str, verb: str, body: bytes, ctype: str,
+                        deadline_at: float, priority: str | None,
+                        ctx: "TraceContext | None") -> _Answer | None:
+    """Forward one request to the owning router's peer listener. Returns
+    its complete answer, or None on a transport failure (counted in
+    cache_peer_errors_total — the caller degrades to local-only)."""
+    h = state.handles[name]
+    remaining = deadline_at - time.perf_counter()
+    headers = {"X-Timeout-Ms": f"{max(1.0, remaining * 1e3):.0f}"}
+    if priority:
+        headers["X-Priority"] = priority
+    if ctype:
+        headers["Content-Type"] = ctype
+    span_id = None
+    if ctx is not None:
+        span_id = ctx.new_span_id()
+        headers["X-Trace-Id"] = ctx.trace_id
+        headers["X-Parent-Span"] = span_id
+    timeout = aiohttp.ClientTimeout(
+        total=max(0.001, remaining + _DEADLINE_GRACE_S),
+        connect=state.rcfg.connect_timeout_ms / 1e3)
+    h.peer_hops.inc()
+    w0 = time.time()
+    outcome: "int | str" = "transport_error"
+    try:
+        async with state._session.post(
+                f"{owner[1]}/peer/models/{name}:{verb}", data=body,
+                headers=headers, timeout=timeout) as r:
+            raw = await r.read()
+            outcome = r.status
+            return _Answer(r.status, r.content_type or "application/json",
+                           raw, r.headers.get("Retry-After"))
+    except asyncio.CancelledError:
+        raise
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+        h.peer_errors.inc()
+        return None
+    finally:
+        if ctx is not None:
+            ctx.span("peer_hop", w0, time.time(), span_id=span_id, tid=name,
+                     owner_router=owner[0], status=outcome)
+
+
+async def _dispatch_local(state: RouterState, cache: ModelCache, key: str,
+                          name: str, verb: str, body: bytes, ctype: str,
+                          deadline_at: float, priority: str | None = None,
+                          ctx: "TraceContext | None" = None) -> _Answer:
+    """This router's own cache shard: hit fast path, else single-flight
+    into the worker relay (the pre-ISSUE-13 _dispatch body)."""
     entry = cache.get(key)
     if entry is not None:
         ct, raw = entry.value
@@ -669,22 +961,39 @@ async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
 
 
 async def handle_healthz(request: web.Request) -> web.Response:
+    """Router health for an external LB fronting N routers (ISSUE 13
+    satellite): 503 only when THIS router can serve nothing (draining, or
+    zero healthy workers anywhere). Lost hosts, dead peer routers, and
+    missing workers all answer 200 "degraded" — degraded capacity is NOT
+    downtime, and an LB that pulls a degraded replica turns a capacity
+    incident into an availability one (docs/ROBUSTNESS.md)."""
     state: RouterState = request.app[ROUTER_KEY]
     sup = state.supervisor.stats()
     if state.draining:
         return web.json_response(
-            {"status": "draining", "workers": sup}, status=503)
+            {"status": "draining", "router_id": state.router_id,
+             "workers": sup}, status=503)
     healthy = sup["healthy"]
     if healthy == 0:
         return web.json_response(
-            {"status": "no_workers", "workers": sup}, status=503,
+            {"status": "no_workers", "router_id": state.router_id,
+             "workers": sup}, status=503,
             headers={"Retry-After": str(state.no_worker_retry_after())})
-    # Degraded capacity is NOT downtime: the front door keeps serving on
-    # the survivors while the supervisor respawns the rest, so the load
-    # balancer must not pull the whole replica (the graceful-degradation
-    # contract, docs/ROBUSTNESS.md).
-    status = "ok" if healthy == sup["configured"] else "degraded"
-    return web.json_response({"status": status, "workers": sup}, status=200)
+    degraded = healthy < sup["configured"]
+    body: dict = {"router_id": state.router_id}
+    if "hosts_configured" in sup:
+        body["hosts"] = {"configured": sup["hosts_configured"],
+                         "up": sup["hosts_up"]}
+        degraded = degraded or sup["hosts_up"] < sup["hosts_configured"]
+    if state.ring is not None:
+        body["routers"] = {"configured": state.rcfg.routers,
+                           "in_ring": len(state.ring.members)}
+        if state.is_primary:
+            degraded = degraded \
+                or len(state.ring.members) < state.rcfg.routers
+    body["status"] = "degraded" if degraded else "ok"
+    body["workers"] = sup
+    return web.json_response(body, status=200)
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
@@ -704,9 +1013,29 @@ async def handle_stats(request: web.Request) -> web.Response:
         out["robustness"]["lock_witness"] = witness.snapshot()
     out["workers"] = state.supervisor.stats()
     out["router"] = {
+        "router_id": state.router_id,
+        "is_primary": state.is_primary,
         "generations": dict(state.generations),
         "retry_max": state.rcfg.retry_max,
         "hedge_ms": state.rcfg.hedge_ms,
+    }
+    if state.ring is not None:
+        out["router"]["ring"] = {
+            "members": {str(rid): url
+                        for rid, url in sorted(state.ring.members.items())},
+            "size": len(state.ring.members),
+        }
+    if state.peer_sup is not None:
+        out["routers"] = state.peer_sup.stats()
+    # Topology block (ISSUE 13 satellite: the multi-machine seam,
+    # tpuserve.parallel.distributed, surfaces its counterpart on every
+    # WORKER's /stats — the router is device-free, so its topology is the
+    # failure-domain layout instead).
+    out["topology"] = {
+        "router_id": state.router_id,
+        "routers_configured": state.rcfg.routers,
+        "hosts_configured": state.rcfg.hosts,
+        "workers_per_domain": state.rcfg.workers,
     }
     out["trace"] = state.recorder.stats()
     if state.caches:
@@ -793,7 +1122,7 @@ async def handle_worker_proxy(request: web.Request) -> web.Response:
         return _err(404, f"unknown worker page {page!r}")
     if not 0 <= wid < state.supervisor.n:
         return _err(404, f"no worker slot {wid}")
-    w = state.supervisor.slots[wid]
+    w = state.supervisor.worker_by_id(wid)
     if w is None:
         return _err(503, f"worker {wid} is down (respawning)")
     try:
@@ -809,11 +1138,38 @@ async def handle_worker_proxy(request: web.Request) -> web.Response:
         return _err(503, f"worker {wid} unreachable: {e}")
 
 
+async def _proxy_admin_to_primary(state: RouterState, method: str,
+                                  path: str) -> web.Response:
+    """A peer router never fans admin out itself — the PRIMARY owns the
+    generation counter and the all-or-nothing reload contract, so one
+    router must serialize fleet transitions. Proxy over its peer listener
+    (the public port is SO_REUSEPORT-shared and cannot address the primary
+    specifically)."""
+    if state.topo is None:
+        return _err(503, "no primary to proxy the admin fan-out to")
+    try:
+        async with state._session.request(
+                method, f"{state.topo.url}{path}",
+                timeout=aiohttp.ClientTimeout(total=180.0)) as r:
+            raw = await r.read()
+            return web.Response(
+                body=raw, status=r.status,
+                content_type=r.content_type or "application/json")
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:  # noqa: BLE001 — primary down mid-admin
+        return _err(503, f"primary router unreachable for admin fan-out: "
+                         f"{type(e).__name__}: {e}")
+
+
 async def handle_reload(request: web.Request) -> web.Response:
     state: RouterState = request.app[ROUTER_KEY]
     name = request.match_info["name"]
     if name not in state.handles:
         return _err(404, f"unknown model {name!r}")
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(
+            state, "POST", f"/peer/admin/{name}:reload")
     status, body = await state.fanout_reload(name)
     return web.json_response(body, status=status)
 
@@ -823,6 +1179,9 @@ async def handle_rollback(request: web.Request) -> web.Response:
     name = request.match_info["name"]
     if name not in state.handles:
         return _err(404, f"unknown model {name!r}")
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(
+            state, "POST", f"/peer/admin/{name}:rollback")
     status, body = await state.fanout_simple(name, "rollback")
     return web.json_response(body, status=status)
 
@@ -832,8 +1191,121 @@ async def handle_versions(request: web.Request) -> web.Response:
     name = request.match_info["name"]
     if name not in state.handles:
         return _err(404, f"unknown model {name!r}")
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(
+            state, "GET", f"/peer/admin/{name}/versions")
     status, body = await state.fanout_simple(name, "versions")
     return web.json_response(body, status=status)
+
+
+# -- peer control plane (ISSUE 13) -------------------------------------------
+
+async def handle_peer_state(request: web.Request) -> web.Response:
+    """GET /peer/state — the topology peers sync: worker addresses, ring
+    membership, cache generations (authoritative on the primary)."""
+    state: RouterState = request.app[ROUTER_KEY]
+    return web.json_response(state.peer_state())
+
+
+async def handle_peer_invalidate(request: web.Request) -> web.Response:
+    """POST /peer/invalidate {model, generation} — push-path half of the
+    fleet-reload invalidation (the poll sync is the backstop)."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        data = await request.json()
+        name = data["model"]
+        gen = int(data["generation"])
+    except (ValueError, KeyError, TypeError):
+        return _err(400, "body must be {model, generation}")
+    if name in state.generations and state.generations[name] != gen:
+        state.generations[name] = gen
+        cache = state.caches.get(name)
+        if cache is not None:
+            cache.clear()
+    return web.json_response({"ok": True, "generation":
+                              state.generations.get(name)})
+
+
+def _peer_relay_handler(verb: str):
+    async def handler(request: web.Request) -> web.Response:
+        return await handle_peer_relay(request, verb)
+
+    return handler
+
+
+async def handle_peer_relay(request: web.Request, verb: str) -> web.Response:
+    """POST /peer/models/{name}:{verb} — a sibling router forwarded a
+    request whose cache key THIS router owns. Serve it through the LOCAL
+    shard (hit → single-flight → worker relay), never re-forward: the
+    origin did admission/shed checks and owns breaker accounting, and a
+    ring disagreement mid-membership-change must terminate here, not
+    loop."""
+    state: RouterState = request.app[ROUTER_KEY]
+    name = request.match_info["name"]
+    h = state.handles.get(name)
+    if h is None:
+        return _err(404, f"unknown model {name!r}")
+    ctx = TraceContext.from_headers(request.headers, pid=0)
+    priority = request.headers.get("X-Priority")
+    t_start = time.perf_counter()
+    body = await request.read()
+    ctype = request.content_type or ""
+    try:
+        timeout_ms = _requested_timeout_ms(request, body, ctype)
+    except ValueError as e:
+        return _err(400, str(e), trace=ctx)
+    timeout_s = (timeout_ms if timeout_ms is not None
+                 else h.mcfg.request_timeout_ms) / 1e3
+    deadline_at = t_start + timeout_s
+    h.peer_serves.inc()
+    state._inflight += 1
+    wall0 = time.time()
+    try:
+        cache = state.caches.get(name)
+        if cache is None:
+            ans = await state._relay(name, verb, body, ctype, deadline_at,
+                                     priority, ctx)
+        else:
+            key = cache.key_for((verb, ctype, body))
+            ans = await _dispatch_local(state, cache, key, name, verb, body,
+                                        ctype, deadline_at, priority, ctx)
+    except NoHealthyWorker as e:
+        return _err(503, "no healthy worker; capacity respawning",
+                    retry_after=max(1, math.ceil(e.eta_s)), trace=ctx)
+    except (RelayDeadline, asyncio.TimeoutError):
+        return _err(504,
+                    f"request deadline ({timeout_s * 1e3:.0f} ms) exceeded",
+                    trace=ctx)
+    except UpstreamFailed:
+        return _err(503, "workers unreachable; retry",
+                    retry_after=state.no_worker_retry_after(), trace=ctx)
+    finally:
+        state._inflight -= 1
+        dur_s = time.perf_counter() - t_start
+        ctx.root_span("peer_serve", wall0, wall0 + dur_s, tid=name)
+    resp = ans.to_response()
+    resp.headers["X-Trace-Id"] = ctx.trace_id
+    return resp
+
+
+def make_peer_app(state: RouterState) -> web.Application:
+    """The loopback control-plane app every router binds next to its
+    public listener: topology for peers, forwarded cache hops, push
+    invalidation, and (on the primary) the admin fan-out entry that peer
+    routers proxy to."""
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    app[ROUTER_KEY] = state
+    app.router.add_get("/peer/state", handle_peer_state)
+    app.router.add_post("/peer/invalidate", handle_peer_invalidate)
+    for verb in _VERBS:
+        app.router.add_post(f"/peer/models/{{name}}:{verb}",
+                            _peer_relay_handler(verb))
+    app.router.add_post("/peer/admin/{name}:reload", handle_reload)
+    app.router.add_post("/peer/admin/{name}:rollback", handle_rollback)
+    app.router.add_get("/peer/admin/{name}/versions", handle_versions)
+    app.router.add_get("/peer/stats", handle_stats)
+    app.router.add_get("/peer/healthz", handle_healthz)
+    return app
 
 
 async def handle_index(request: web.Request) -> web.Response:
@@ -844,7 +1316,11 @@ async def handle_index(request: web.Request) -> web.Response:
 
 # -- app wiring --------------------------------------------------------------
 
-def make_router_app(state: RouterState) -> web.Application:
+def make_router_app(state: RouterState,
+                    own_lifecycle: bool = True) -> web.Application:
+    """The public-port app. ``own_lifecycle=False`` (peer processes, and
+    fixtures that sequence start/stop themselves) skips the startup/cleanup
+    hooks."""
     app = web.Application(client_max_size=64 * 1024 * 1024)
     app[ROUTER_KEY] = state
     for verb in _VERBS:
@@ -862,15 +1338,33 @@ def make_router_app(state: RouterState) -> web.Application:
     app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/", handle_index)
 
-    async def on_startup(app: web.Application) -> None:
-        await state.start()
+    if own_lifecycle:
+        async def on_startup(app: web.Application) -> None:
+            await state.start()
 
-    async def on_cleanup(app: web.Application) -> None:
-        await state.stop()
+        async def on_cleanup(app: web.Application) -> None:
+            await state.stop()
 
-    app.on_startup.append(on_startup)
-    app.on_cleanup.append(on_cleanup)
+        app.on_startup.append(on_startup)
+        app.on_cleanup.append(on_cleanup)
     return app
+
+
+def bind_public_socket(host: str, port: int):
+    """Bind (and return) the shared public listener socket with
+    SO_REUSEPORT so N router processes can serve one port (PR 11's
+    listener trick one tier up). Returns ``(sock, bound_port)``."""
+    import socket as _socket
+
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    try:
+        if hasattr(_socket, "SO_REUSEPORT"):
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+        sock.bind((host, port))
+    except OSError:
+        sock.close()
+        raise
+    return sock, sock.getsockname()[1]
 
 
 async def serve_router_async(state: RouterState,
@@ -881,12 +1375,23 @@ async def serve_router_async(state: RouterState,
     cfg = state.cfg
     app = make_router_app(state)
     runner = web.AppRunner(app, access_log=None)
-    await runner.setup()
-    site = web.TCPSite(runner, cfg.host, cfg.port)
+    if cfg.router.routers > 1:
+        # Bind the SO_REUSEPORT socket BEFORE state.start() runs (at
+        # runner.setup): the peer routers it spawns must join the final
+        # (host, port), ephemeral included.
+        sock, port = bind_public_socket(cfg.host, cfg.port)
+        state.public_addr = (cfg.host, port)
+        await runner.setup()
+        site = web.SockSite(runner, sock)
+    else:
+        await runner.setup()
+        site = web.TCPSite(runner, cfg.host, cfg.port)
     await site.start()
     state.serving_addresses = list(runner.addresses)
-    log.info("router serving on %s (%d workers)", state.serving_addresses,
-             cfg.router.workers)
+    log.info("router %d serving on %s (%d router(s), %d host(s), "
+             "%d worker(s)%s)", state.router_id, state.serving_addresses,
+             cfg.router.routers, cfg.router.hosts, cfg.router.workers,
+             " per host" if cfg.router.hosts else "")
 
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()

@@ -49,7 +49,7 @@ class WorkerHandle:
 
     __slots__ = ("wid", "proc", "conn", "port", "pid", "base_url",
                  "healthy", "health_fails", "inflight", "picked_seq",
-                 "started_at")
+                 "started_at", "host")
 
     def __init__(self, wid: int, proc, conn, port: int, pid: int,
                  host: str) -> None:
@@ -66,12 +66,47 @@ class WorkerHandle:
         self.inflight = 0
         self.picked_seq = 0
         self.started_at = time.monotonic()
+        # Failure-domain id. The flat supervisor has no host layer: every
+        # worker is its own domain (host-aware hedging degrades to the
+        # PR-8 different-worker rule). HostSupervisor's refs carry a real
+        # host id here (tpuserve.workerproc.hosts).
+        self.host: int | None = None
 
     def close(self) -> None:
         try:
             self.conn.close()
         except OSError:
             pass
+
+
+def spawn_worker_blocking(wcfg, wid: int, spawn_timeout_s: float):
+    """Spawn one worker process and wait for its ready handshake. Blocking
+    (Process.start + the pipe poll) — call from an executor thread in the
+    router, or from the host agent's own process (tpuserve.workerproc.hosts,
+    which runs the same handshake one level down).
+
+    Returns ``(proc, parent_conn, port, pid)``; raises on boot failure with
+    the child killed and the pipe closed."""
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    proc = ctx.Process(target=worker_main, args=(wcfg, wid, child),
+                       daemon=True, name=f"tpuserve-worker-{wid}")
+    proc.start()
+    child.close()
+    try:
+        if not parent.poll(spawn_timeout_s):
+            raise TimeoutError(
+                f"worker {wid} not ready after {spawn_timeout_s:.0f}s")
+        msg = parent.recv()
+        if msg.get("op") != "ready":
+            raise RuntimeError(f"worker {wid} failed at boot: {msg}")
+    except BaseException:
+        if proc.is_alive():
+            proc.kill()
+        proc.join(5.0)
+        parent.close()
+        raise
+    return proc, parent, int(msg["port"]), int(msg.get("pid", proc.pid))
 
 
 class WorkerSupervisor:
@@ -136,27 +171,8 @@ class WorkerSupervisor:
     def _spawn_blocking(self, wid: int) -> WorkerHandle:
         """Spawn one worker and wait for its ready handshake (executor
         thread — Process.start and the pipe poll both block)."""
-        ctx = mp.get_context("spawn")
-        parent, child = ctx.Pipe()
-        proc = ctx.Process(target=worker_main,
-                           args=(self._worker_cfgs[wid], wid, child),
-                           daemon=True, name=f"tpuserve-worker-{wid}")
-        proc.start()
-        child.close()
-        try:
-            if not parent.poll(self.rcfg.spawn_timeout_s):
-                raise TimeoutError(
-                    f"worker {wid} not ready after "
-                    f"{self.rcfg.spawn_timeout_s:.0f}s")
-            msg = parent.recv()
-            if msg.get("op") != "ready":
-                raise RuntimeError(f"worker {wid} failed at boot: {msg}")
-        except BaseException:
-            if proc.is_alive():
-                proc.kill()
-            proc.join(5.0)
-            parent.close()
-            raise
+        proc, parent, port, pid = spawn_worker_blocking(
+            self._worker_cfgs[wid], wid, self.rcfg.spawn_timeout_s)
         if self._stopping:
             # The supervisor stopped while this spawn was in flight on its
             # executor thread (the awaiting task was cancelled, so nobody
@@ -166,8 +182,7 @@ class WorkerSupervisor:
             proc.join(5.0)
             parent.close()
             raise RuntimeError(f"supervisor stopping; discarded worker {wid}")
-        return WorkerHandle(wid, proc, parent, int(msg["port"]),
-                            int(msg.get("pid", proc.pid)),
+        return WorkerHandle(wid, proc, parent, port, pid,
                             self.cfg.worker.host)
 
     async def stop(self, drain: bool = True) -> None:
@@ -331,13 +346,47 @@ class WorkerSupervisor:
     def healthy_workers(self) -> list[WorkerHandle]:
         return [h for h in self.slots if h is not None and h.healthy]
 
-    def pick(self, exclude: set[int] = frozenset()) -> WorkerHandle | None:
+    def live_workers(self) -> list[WorkerHandle]:
+        """Every slot with a live process — admin fan-outs must reach
+        unhealthy-but-alive workers too, or the fleet's versions diverge."""
+        return [h for h in self.slots
+                if h is not None and h.proc.is_alive()]
+
+    def worker_by_id(self, wid: int) -> WorkerHandle | None:
+        if not 0 <= wid < self.n:
+            return None
+        return self.slots[wid]
+
+    def down_domains(self) -> list[str]:
+        """Failure domains currently dead/respawning — a fleet-wide reload
+        must refuse while any exists (a dead slot respawns from the boot
+        config and would diverge from a freshly published version)."""
+        return [f"worker{i}" for i, h in enumerate(self.slots)
+                if h is None or not h.proc.is_alive()]
+
+    def host_of(self, h: WorkerHandle) -> int | None:
+        return h.host
+
+    def note_transport_failure(self, h: WorkerHandle) -> None:
+        """Host-breaker food (tpuserve.workerproc.hosts). The flat
+        supervisor has no host layer: health probes + retry already route
+        around a dead worker, so this is a no-op."""
+
+    def note_success(self, h: WorkerHandle) -> None:
+        pass
+
+    def pick(self, exclude: set[int] = frozenset(),
+             exclude_hosts: set[int] = frozenset()) -> WorkerHandle | None:
         """Least-loaded healthy worker not in ``exclude``; ties break to
         the least-recently-picked so equal load round-robins instead of
-        piling onto slot 0."""
+        piling onto slot 0. ``exclude_hosts`` is the host-aware hedging
+        seam — with no host layer every worker's host is None, so the
+        different-worker rule (``exclude``) is the whole constraint."""
         best: WorkerHandle | None = None
         for h in self.slots:
             if h is None or not h.healthy or h.wid in exclude:
+                continue
+            if h.host is not None and h.host in exclude_hosts:
                 continue
             if best is None \
                     or (h.inflight, h.picked_seq) < (best.inflight,
