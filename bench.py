@@ -78,6 +78,65 @@ def measure_link_rate_mbps(chunk_bytes: int = 8 << 20) -> float:
     return 0.0
 
 
+def device_seconds_snapshot(metrics, model: str) -> dict[int, float]:
+    """Per-replica device_seconds_total values for one model (ISSUE 14):
+    the ledger the utilization block differences over the measured
+    window."""
+    import re as _re
+
+    pat = _re.compile(
+        rf"^device_seconds_total\{{model={_re.escape(model)},"
+        rf"replica=(\d+)\}}$")
+    with metrics._lock:
+        counters = dict(metrics._counters)
+    out: dict[int, float] = {}
+    for name, c in counters.items():
+        m = pat.match(name)
+        if m is not None:
+            out[int(m.group(1))] = c.value
+    return out
+
+
+def utilization_block(before: dict[int, float], after: dict[int, float],
+                      wall_s: float, n_chips: int) -> dict:
+    """The bench `utilization` block: per-replica busy fraction (device
+    seconds / wall) over the measured window, plus the aggregate across
+    the chips the run occupied."""
+    per_replica = {}
+    total = 0.0
+    for rep in sorted(after):
+        delta = max(0.0, after[rep] - before.get(rep, 0.0))
+        total += delta
+        per_replica[str(rep)] = round(delta / wall_s, 4) if wall_s > 0 else 0.0
+    return {
+        "wall_s": round(wall_s, 2),
+        "device_seconds": round(total, 2),
+        "n_chips": n_chips,
+        "per_replica": per_replica,
+        # Aggregate busy fraction of the occupied chip set: device seconds
+        # spread over n_chips × wall — 1.0 means every chip busy the whole
+        # window, low values name the starvation the roofline must explain.
+        "mean_utilization": round(total / (wall_s * n_chips), 4)
+        if wall_s > 0 and n_chips else 0.0,
+    }
+
+
+def burn_from_snapshots(bounds, before: dict, after: dict,
+                        objective_ms: float, availability: float
+                        ) -> "float | None":
+    """One pass's SLO burn rate from latency-histogram snapshots: the
+    pass's delta counts → bad fraction over the objective → / budget
+    (tpuserve.telemetry.slo math, applied bench-side per pass)."""
+    from tpuserve.telemetry.slo import good_fraction
+
+    delta = [max(0, a - b) for a, b in zip(after["counts"],
+                                           before["counts"])]
+    good = good_fraction(list(bounds), delta, objective_ms)
+    if good is None:
+        return None
+    return round((1.0 - good) / (1.0 - availability), 3)
+
+
 def warmup_is_stable(values: list[float], tol: float = 0.10) -> bool:
     """True once the last two warmup passes agree within ``tol`` (relative
     to the larger): the signal that executable warmup, arena ramp, and TCP
@@ -721,6 +780,20 @@ def main() -> int:
             # 8-chip framed-wire config, not just in unit tests).
             rt_bench = state.runtimes.get("resnet50")
             comp0 = getattr(rt_bench, "compiles_total", None)
+            # Telemetry evidence for the measured window (ISSUE 14): the
+            # per-replica device-seconds ledger deltas over the window's
+            # wall time become the `utilization` block, and each pass's
+            # latency-histogram delta becomes a burn rate against the
+            # bench SLO (BENCH_SLO_MS objective / BENCH_SLO_AVAIL target)
+            # — the next TPU round lands with chip-occupancy proof
+            # attached, not just a throughput number.
+            slo_ms = env_f("BENCH_SLO_MS", 1000.0)
+            slo_avail = env_f("BENCH_SLO_AVAIL", 0.999)
+            total_hist = state.metrics.histogram(
+                "latency_ms{model=resnet50,phase=total}")
+            util0 = device_seconds_snapshot(state.metrics, "resnet50")
+            wall0 = time.perf_counter()
+            pass_burns: list[float | None] = []
             passes = []
             while True:
                 # Pass-boundary independence: every pass regenerates the
@@ -731,6 +804,7 @@ def main() -> int:
                 # LRU round-robin thrash (pool > capacity) does the job.
                 for c in state.caches.values():
                     c.clear()
+                hist_before = total_hist.snapshot()
                 res = await run_load(
                     cfg, payload, ctype, duration,
                     2 if warmups or passes else warmup,
@@ -738,8 +812,11 @@ def main() -> int:
                     distinct=distinct, synth=synth_kind, edge=wire,
                     wire_proto=wire_proto, frame_kind=wire_format,
                     procs=load_procs)
-                print(f"# closed-loop pass {len(passes) + 1}: {res}",
-                      file=sys.stderr)
+                pass_burns.append(burn_from_snapshots(
+                    total_hist.bounds, hist_before, total_hist.snapshot(),
+                    slo_ms, slo_avail))
+                print(f"# closed-loop pass {len(passes) + 1}: {res} "
+                      f"(burn {pass_burns[-1]})", file=sys.stderr)
                 passes.append(res)
                 if len(passes) < min_passes:
                     continue
@@ -752,6 +829,11 @@ def main() -> int:
                           f"never converged under {spread_target}% within "
                           f"{max_passes} passes", file=sys.stderr)
                     break
+            measured_wall_s = time.perf_counter() - wall0
+            util1 = device_seconds_snapshot(state.metrics, "resnet50")
+            utilization = utilization_block(util0, util1, measured_wall_s,
+                                            getattr(rt_bench, "n_chips", 1)
+                                            or 1)
             miss_c1 = counter_snapshot(state.metrics, "resnet50")
             comp1 = getattr(rt_bench, "compiles_total", None)
             compile_delta = (comp1 - comp0) if comp0 is not None else None
@@ -813,7 +895,14 @@ def main() -> int:
                     "warmups": warmups, "hit": hit_block,
                     "miss_hit_rate": hit_rate(miss_delta),
                     "compile_delta": compile_delta,
-                    "ingest": ingest_stats}
+                    "ingest": ingest_stats,
+                    "utilization": utilization,
+                    "slo": {"objective_latency_ms": slo_ms,
+                            "availability": slo_avail,
+                            "per_pass_burn": pass_burns,
+                            "worst_burn": max(
+                                (b for b in pass_burns if b is not None),
+                                default=None)}}
         finally:
             await stop_ingest_loops(ingest_threads)
             await runner.cleanup()
@@ -955,6 +1044,14 @@ def main() -> int:
             req_bytes=(frame_wire.frame_nbytes(frame_kind, wire, frame_items)
                        if wire_proto == "frame" and frame_items else None)),
     }
+    # Telemetry evidence (ISSUE 14): chip-occupancy over the measured
+    # window next to the throughput it bought, and the per-pass SLO burn
+    # summary — the roofline carries the same utilization block so its
+    # ceiling percentages are read against how busy the chips really were.
+    line["utilization"] = r["utilization"]
+    line["slo"] = r["slo"]
+    if isinstance(line.get("roofline"), dict):
+        line["roofline"]["utilization"] = r["utilization"]
     if r["hit"]:
         line["hit_heavy"] = r["hit"]
     if open_res:

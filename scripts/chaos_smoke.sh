@@ -44,6 +44,9 @@ scripts/multichip_smoke.sh
 echo "== trace smoke (X-Trace-Id everywhere, stitched slow trace across the router->worker hop, exemplars, compile delta 0) =="
 scripts/trace_smoke.sh
 
+echo "== telemetry smoke (fleet sum exact, burn-rate alert fires + clears, history, compile delta 0) =="
+scripts/telemetry_smoke.sh
+
 echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
 scripts/worker_drill.sh
 

@@ -1,0 +1,268 @@
+#!/usr/bin/env bash
+# Telemetry smoke (ISSUE 14): a REAL router + 2-host fleet (1 worker per
+# host) under closed-loop load, gating the telemetry plane's contract
+# (docs/OBSERVABILITY.md "The telemetry plane"):
+#   1. fleet aggregation is EXACT: requests_total summed out of
+#      /metrics/fleet equals the sum of the per-worker counters, and
+#      /stats/fleet shows every source up;
+#   2. the SLO burn-rate engine FIRES under an injected worker_slow
+#      latency fault (every early request blows the 250 ms objective) and
+#      returns to ok after the fault exhausts and the bad windows age out;
+#   3. /stats/history is non-empty on the router AND on a worker (via the
+#      /workers/{wid}/stats/history proxy), with derived rates;
+#   4. /metrics is OpenMetrics-enveloped (# EOF, content negotiation);
+#   5. runtime_compiles_total delta is exactly 0 across the loaded window
+#      (telemetry adds no specializations).
+# Witnessed (TPUSERVE_LOCK_WITNESS=1): the sampler thread, SLO engine,
+# and fleet scrape run against every lock family under load, so the run
+# doubles as a race-detection pass.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export TPUSERVE_LOCK_WITNESS=1
+
+PORT=18571
+TMPD="$(mktemp -d /tmp/telemetry_smoke_XXXX)"
+CFG="$TMPD/cfg.toml"
+cat > "$CFG" <<EOF
+host = "127.0.0.1"
+port = $PORT
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+
+[telemetry]
+sample_interval_s = 0.25
+burn_windows_s = [2.0, 4.0, 30.0]
+
+[router]
+enabled = true
+workers = 1
+hosts = 2
+retry_max = 2
+health_interval_s = 0.2
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2, 4]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+
+[model.slo]
+latency_ms = 250.0
+availability = 0.999
+burn_alert = 10.0
+
+[faults]
+enabled = true
+seed = 5
+
+[[faults.rule]]
+kind = "worker_slow"
+model = "toy"
+probability = 1.0
+count = 40
+delay_ms = 900.0
+EOF
+
+python -m tpuserve serve --config "$CFG" &
+SERVER_PID=$!
+trap 'kill -9 $SERVER_PID 2>/dev/null || true; rm -rf "$TMPD"' EXIT
+
+for _ in $(seq 1 180); do
+  if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then break; fi
+  sleep 0.5
+done
+curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null
+
+# Compile-delta window opens after startup compiles, before any load.
+curl -fsS "http://127.0.0.1:$PORT/workers/0/metrics" > "$TMPD/w0_before.txt"
+curl -fsS "http://127.0.0.1:$PORT/workers/1/metrics" > "$TMPD/w1_before.txt"
+
+python - "$TMPD" "http://127.0.0.1:$PORT" <<'EOF'
+import io
+import json
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+tmpd, base = sys.argv[1], sys.argv[2]
+
+
+def get(path, accept=None):
+    req = urllib.request.Request(base + path)
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def post(path, body, ctype="application/x-npy"):
+    req = urllib.request.Request(base + path, data=body,
+                                 headers={"Content-Type": ctype})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def npy(seed):
+    buf = io.BytesIO()
+    np.save(buf, np.random.default_rng(seed).integers(
+        0, 255, (8, 8, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+# Closed-loop load: 6 worker threads posting distinct payloads until told
+# to stop. The first ~80 requests ride the worker_slow fault (900 ms vs
+# the 250 ms objective) — the bad traffic the burn engine must fire on.
+stop = threading.Event()
+counts = {"ok": 0, "err": 0}
+lock = threading.Lock()
+
+
+def loader(tid):
+    i = 0
+    while not stop.is_set():
+        status, body = post("/v1/models/toy:classify", npy(tid * 10_000 + i))
+        with lock:
+            counts["ok" if status == 200 else "err"] += 1
+        if status != 200:
+            print(f"load error {status}: {body[:200]}", file=sys.stderr)
+        i += 1
+
+
+threads = [threading.Thread(target=loader, args=(t,), daemon=True)
+           for t in range(6)]
+for t in threads:
+    t.start()
+
+
+def alert_state():
+    _, _, raw = get("/alerts")
+    data = json.loads(raw)
+    return data["models"].get("toy", {}).get("state"), data
+
+
+# Gate 2a: the alert FIRES while the latency fault serves.
+state, data = None, None
+deadline = time.time() + 30.0
+while time.time() < deadline:
+    state, data = alert_state()
+    if state == "firing":
+        break
+    time.sleep(0.25)
+assert state == "firing", f"burn alert never fired: {json.dumps(data)}"
+burn = data["models"]["toy"]["burn"]
+print(f"alert FIRING: burn={burn}")
+assert burn["2s"] and burn["2s"] > 10.0, burn
+
+# Gate 2b: the fault exhausts (count 40/worker) under continuing load and
+# the alert returns to ok once the bad windows age out.
+state = None
+deadline = time.time() + 60.0
+while time.time() < deadline:
+    state, data = alert_state()
+    if state == "ok":
+        break
+    time.sleep(0.25)
+assert state == "ok", \
+    f"alert never cleared after the fault: {json.dumps(data)}"
+print(f"alert cleared to ok (served={counts['ok']})")
+
+stop.set()
+for t in threads:
+    t.join(10.0)
+assert counts["ok"] > 100 and counts["err"] == 0, counts
+time.sleep(1.0)  # quiesce: no request in flight during the sum gates
+
+# Gate 4: OpenMetrics envelope + content negotiation on the router.
+_, headers, raw = get("/metrics")
+assert headers["Content-Type"].startswith("text/plain; version=0.0.4"), \
+    headers["Content-Type"]
+assert raw.decode().rstrip().endswith("# EOF"), "missing # EOF terminator"
+_, headers, _ = get("/metrics", accept="application/openmetrics-text; "
+                                       "version=1.0.0")
+assert headers["Content-Type"].startswith(
+    "application/openmetrics-text; version=1.0.0"), headers["Content-Type"]
+
+# Gate 1: fleet-summed counters == Σ per-worker counters, EXACTLY.
+RE_REQ = re.compile(r'^requests_total\{model="toy"\} ([0-9.e+]+)$', re.M)
+
+
+def req_total(text):
+    m = RE_REQ.search(text)
+    return float(m.group(1)) if m else 0.0
+
+
+_, _, fleet_raw = get("/metrics/fleet")
+fleet_text = fleet_raw.decode()
+per_worker = 0.0
+for wid in (0, 1):
+    _, _, wraw = get(f"/workers/{wid}/metrics")
+    with open(f"{tmpd}/w{wid}_after.txt", "w", encoding="utf-8") as f:
+        f.write(wraw.decode())
+    per_worker += req_total(wraw.decode())
+fleet_sum = req_total(fleet_text)
+assert fleet_sum == per_worker > 0, (fleet_sum, per_worker)
+assert 'fleet_source_up{proc="worker0"} 1' in fleet_text, "source gauges"
+print(f"fleet sum exact: {fleet_sum} == {per_worker}")
+
+_, _, raw = get("/stats/fleet")
+rollup = json.loads(raw)
+assert rollup["stale"] == [] and rollup["down_domains"] == [], rollup
+assert rollup["models"]["toy"]["requests_total"] == fleet_sum, rollup
+
+# Gate 3: history non-empty on the router AND a worker, rates derived.
+_, _, raw = get("/stats/history?metric=router_requests_total&window_s=120")
+series = json.loads(raw)["series"]
+assert series and len(series[0]["t"]) >= 2, series
+assert series[0]["increase"] > 0, series[0]
+_, _, raw = get("/workers/0/stats/history?metric=requests_total")
+wseries = json.loads(raw)["series"]
+assert wseries and len(wseries[0]["t"]) >= 2, wseries
+assert "rate_per_s" in wseries[0], wseries[0]
+print(f"history: router n={len(series[0]['t'])} "
+      f"worker n={len(wseries[0]['t'])}")
+EOF
+
+# Gate 5: compile delta 0 on every worker across the loaded window.
+python - "$TMPD" <<'EOF'
+import re
+import sys
+
+tmpd = sys.argv[1]
+
+
+def compiles(path):
+    total = 0.0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            m = re.match(r'^runtime_compiles_total\{[^}]*\} ([0-9.e+]+)', line)
+            if m:
+                total += float(m.group(1))
+    return total
+
+
+for wid in (0, 1):
+    before = compiles(f"{tmpd}/w{wid}_before.txt")
+    after = compiles(f"{tmpd}/w{wid}_after.txt")
+    assert before > 0, f"worker {wid}: no compiles recorded at startup?"
+    assert after == before, \
+        f"worker {wid}: compile delta {after - before} != 0"
+    print(f"worker {wid}: compile delta 0 ({before} at startup)")
+EOF
+
+kill -TERM $SERVER_PID 2>/dev/null || true
+wait $SERVER_PID 2>/dev/null || true
+echo "telemetry smoke OK"

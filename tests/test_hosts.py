@@ -383,6 +383,83 @@ def test_host_kill_degrades_then_reabsorbs(hostfleet):
     run(go())
 
 
+def test_fleet_scrape_degrades_stale_never_500(hostfleet):
+    """Fleet-aggregation degradation (ISSUE 14 satellite): a healthy
+    scrape sums counters EXACTLY across workers; SIGKILLing an entire
+    host mid-poll stale-marks that domain's sources in /metrics/fleet
+    and /stats/fleet — never a 5xx — and after the PR-13 respawn the
+    scrape is whole again."""
+    from tpuserve.telemetry.fleet import sum_counter
+
+    run, session, base, state = hostfleet
+
+    async def scrape():
+        async with session.get(f"{base}/metrics/fleet") as r:
+            text = await r.text()
+            assert r.status == 200, text  # the never-5xx contract
+        async with session.get(f"{base}/stats/fleet") as r:
+            rollup = await r.json()
+            assert r.status == 200, rollup
+        return text, rollup
+
+    async def go():
+        # 1) healthy fleet: serve some traffic, then prove exact summing.
+        for i in range(8):
+            status, body, _ = await _post(session, base, "toy", npy(300 + i))
+            assert status == 200, body
+        merged, rollup = await scrape()
+        per_worker = 0.0
+        for wid in range(4):
+            async with session.get(f"{base}/workers/{wid}/metrics") as r:
+                assert r.status == 200
+                per_worker += sum_counter(await r.text(), "requests_total",
+                                          'model="toy"')
+        fleet_sum = sum_counter(merged, "requests_total", 'model="toy"')
+        assert fleet_sum == per_worker > 0, (fleet_sum, per_worker)
+        assert rollup["models"]["toy"]["requests_total"] == fleet_sum
+        assert rollup["stale"] == [] and rollup["down_domains"] == []
+        assert all(v == "up" for v in rollup["sources"].values())
+        # gauges are per-process, worker_up stays the router's own
+        assert 'proc="worker0"' in merged
+        # true fleet latency quantiles from the merged buckets
+        assert rollup["models"]["toy"]["fleet_latency_p99_ms"] is not None
+
+        # 2) kill host 1 (agent + workers, one process group) mid-poll.
+        victim = state.supervisor.hosts[1]
+        os.killpg(victim.pgid, signal.SIGKILL)
+        merged, rollup = await scrape()  # immediately: must not 5xx
+        stale = set(rollup["stale"])
+        assert {"worker2", "worker3"} <= stale, rollup
+        assert 'fleet_source_up{proc="worker2"} 0' in merged
+        assert "# STALE worker2" in merged
+        # the survivor host's counters still merge
+        assert sum_counter(merged, "requests_total", 'model="toy"') > 0
+        # availability through the scrape window
+        status, body, _ = await _post(session, base, "toy", npy(333))
+        assert status == 200, body
+
+        # 3) recover: the domain re-absorbs and the scrape is whole.
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            merged, rollup = await scrape()
+            if not rollup["stale"] and not rollup["down_domains"]:
+                break
+            await asyncio.sleep(0.5)
+        assert rollup["stale"] == [], rollup
+        assert all(v == "up" for v in rollup["sources"].values())
+        # Respawned workers restart their counters at 0 — the merged sum
+        # is the CURRENT fleet truth, smaller than before the kill; the
+        # reset-aware compensation lives in the history layer
+        # (TimeSeriesStore), not in the instantaneous merge. The healed
+        # fleet still serves and still sums.
+        status, _, _ = await _post(session, base, "toy", npy(334))
+        assert status == 200
+        merged, _ = await scrape()
+        assert sum_counter(merged, "requests_total", 'model="toy"') > 0
+
+    run(go())
+
+
 def test_retry_after_reflects_min_respawn_eta(hostfleet):
     """With hosts respawning, respawn_eta_s() is the MINIMUM ETA across
     dead domains — the honest Retry-After when the whole fleet is down."""

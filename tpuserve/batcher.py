@@ -66,7 +66,7 @@ from typing import Any, Hashable
 from tpuserve.config import AdaptiveConfig, PipelineConfig
 from tpuserve.hostpipe import AssemblyArena, SlotPool, StageExecutors
 from tpuserve.models.base import ServingModel
-from tpuserve.obs import PHASES, PRIORITIES, Metrics
+from tpuserve.obs import PHASES, PRIORITIES, Counter, Metrics
 from tpuserve.runtime import ModelRuntime
 
 log = logging.getLogger("tpuserve.batcher")
@@ -176,6 +176,11 @@ class ModelBatcher:
         # device-section seconds (compute phase) when a scheduler is
         # attached; None otherwise. Event-loop-only, like the ledger.
         self.device_time_cb = None
+        # Per-replica device-seconds counters (ISSUE 14): ticked with every
+        # batch's device section regardless of scheduler presence — the
+        # telemetry sampler derives device_utilization{model=,replica=}
+        # from their rates. Sized to the replica count at start().
+        self._c_device_seconds: list[Counter] = []
         # Stage executors are normally server-owned and shared across models
         # (stage-granularity scheduling); a batcher built without one (tests,
         # embedding) creates and later shuts down its own.
@@ -229,6 +234,10 @@ class ModelBatcher:
             self._staging = []
             self.arena = None
             self.depth = 0
+            # Deferred pools own devices out-of-process: all device time
+            # lands on one "replica 0" ledger row.
+            self._c_device_seconds = [
+                self.metrics.device_seconds_counter(self.cfg.name, 0)]
         else:
             n_rep = max(1, int(getattr(self.runtime, "n_replicas", 1)))
             if hasattr(self.runtime, "h2d_sync"):
@@ -261,6 +270,11 @@ class ModelBatcher:
             # the mesh"), prebound once per replica.
             self._g_replica_inflight = [
                 self.metrics.replica_inflight_gauge(self.cfg.name, i)
+                for i in range(n_rep)]
+            # Per-replica device-seconds ledger (ISSUE 14): the telemetry
+            # sampler turns these rates into device_utilization gauges.
+            self._c_device_seconds = [
+                self.metrics.device_seconds_counter(self.cfg.name, i)
                 for i in range(n_rep)]
             arena_slots = pcfg.arena_slots or (self.depth + pcfg.assemble_ahead)
             self.arena = (AssemblyArena(self.model, arena_slots, self.metrics)
@@ -775,6 +789,8 @@ class ModelBatcher:
                 np_out = await out_fut
                 t3 = time.perf_counter()
                 mark("compute", t2, t3)
+                if self._c_device_seconds:
+                    self._c_device_seconds[0].inc(t3 - t2)
                 if self.device_time_cb is not None:
                     self.device_time_cb(t3 - t2)
             else:
@@ -807,6 +823,8 @@ class ModelBatcher:
                         name, "fetch", self.runtime.fetch, outputs)
                     t3 = time.perf_counter()
                     mark("compute", t2, t3)
+                    if replica < len(self._c_device_seconds):
+                        self._c_device_seconds[replica].inc(t3 - t2)
                     if self.device_time_cb is not None:
                         # Fleet device-time ledger: the device section
                         # (dispatch-to-ready) is what models compete for.

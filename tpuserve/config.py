@@ -299,6 +299,95 @@ class TraceConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Fleet telemetry plane (``[telemetry]`` TOML; tpuserve.telemetry,
+    docs/OBSERVABILITY.md "The telemetry plane").
+
+    On by default: a background sampler thread snapshots every counter/
+    gauge/histogram into bounded per-metric rings at ``sample_interval_s``,
+    from which ``GET /stats/history`` serves time-resolved counter rates
+    and histogram-delta quantiles, the SLO engine evaluates multi-window
+    burn rates (``[model.slo]`` blocks → ``/alerts``), and the sampler
+    derives ``device_utilization{model=,replica=}`` from the device-seconds
+    ledger. The router tier additionally scrapes every live worker and peer
+    router into ``GET /metrics/fleet`` / ``/stats/fleet``."""
+
+    enabled: bool = True
+    # Sampler cadence (s): every tick snapshots the whole metric registry
+    # into the rings and re-evaluates burn rates + utilization.
+    sample_interval_s: float = 1.0
+    # History retained per metric (s); ring capacity = history_s /
+    # sample_interval_s, hard-capped at 4096 samples per metric.
+    history_s: float = 600.0
+    # Burn-rate evaluation windows (s), ascending (Google-SRE multi-window
+    # style): an alert FIRES when the burn rate exceeds the model's
+    # `burn_alert` threshold over BOTH the first two windows, is PENDING on
+    # the first alone, and all windows are exported as
+    # slo_burn_rate{model=,window=} gauges.
+    burn_windows_s: list[float] = field(
+        default_factory=lambda: [60.0, 300.0, 1800.0])
+    # Sliding window (s) for deriving device_utilization{model=,replica=}
+    # from the device_seconds_total counters.
+    utilization_window_s: float = 10.0
+    # Per-source budget for the router's fleet scrape (/metrics/fleet):
+    # a worker/peer slower than this is stale-marked, never a 5xx.
+    fleet_timeout_ms: float = 2000.0
+    # Upper bound on POST /debug/profile?duration_ms= (one capture at a
+    # time; the jax.profiler device trace merges with the span ring).
+    profile_max_ms: float = 10000.0
+
+    def __post_init__(self) -> None:
+        if self.sample_interval_s <= 0 or self.history_s <= 0:
+            raise ValueError(
+                "telemetry.sample_interval_s/history_s must be > 0")
+        if len(self.burn_windows_s) < 2 \
+                or any(w <= 0 for w in self.burn_windows_s) \
+                or sorted(self.burn_windows_s) != list(self.burn_windows_s):
+            raise ValueError(
+                "telemetry.burn_windows_s must be >= 2 ascending positive "
+                f"windows, got {self.burn_windows_s}")
+        if self.utilization_window_s <= 0 or self.fleet_timeout_ms <= 0 \
+                or self.profile_max_ms <= 0:
+            raise ValueError(
+                "telemetry.utilization_window_s/fleet_timeout_ms/"
+                "profile_max_ms must be > 0")
+
+
+@dataclass
+class SloConfig:
+    """Per-model service-level objective (``[model.slo]`` TOML;
+    tpuserve.telemetry.slo, docs/OBSERVABILITY.md "The telemetry plane").
+
+    A request is "good" when it answers within ``latency_ms``;
+    ``availability`` is the target good fraction, so the error budget is
+    ``1 - availability`` and the burn rate over a window is
+    (bad fraction) / budget — burn 1.0 spends the budget exactly at the
+    sustainable pace, burn N spends it N× too fast. Evaluated per
+    ``[telemetry] burn_windows_s`` window by the sampler; `latency_ms = 0`
+    (the default) disables the SLO for the model."""
+
+    # Latency objective (ms): requests at or under it are "good".
+    # 0 disables SLO evaluation for this model.
+    latency_ms: float = 0.0
+    # Target good fraction; error budget = 1 - availability.
+    availability: float = 0.999
+    # Burn-rate threshold: FIRING when exceeded over both the short and
+    # mid [telemetry] windows, PENDING on the short alone.
+    burn_alert: float = 10.0
+
+    def __post_init__(self) -> None:
+        if self.latency_ms < 0:
+            raise ValueError(
+                f"slo.latency_ms must be >= 0, got {self.latency_ms}")
+        if not 0.0 < self.availability < 1.0:
+            raise ValueError(
+                f"slo.availability must be in (0, 1), got {self.availability}")
+        if self.burn_alert <= 0:
+            raise ValueError(
+                f"slo.burn_alert must be > 0, got {self.burn_alert}")
+
+
+@dataclass
 class ParallelConfig:
     """Multi-chip serving plan (``[parallel]`` TOML; docs/PERFORMANCE.md
     "Serving on the mesh").
@@ -639,6 +728,11 @@ class ModelConfig:
     # only for models that are genuinely nondeterministic in their input
     # (e.g. unseeded sampling).
     cacheable: bool = True
+    # Service-level objective ([model.slo] sub-table): latency objective +
+    # availability target the telemetry plane's burn-rate engine evaluates
+    # (docs/OBSERVABILITY.md "The telemetry plane"). Defaults to disabled
+    # (latency_ms = 0).
+    slo: SloConfig = field(default_factory=SloConfig)
     # -- robustness (docs/ROBUSTNESS.md) ------------------------------------
     # One-shot batch retry: a failed dispatch re-assembles and re-runs the
     # batch once before failing its futures (absorbs transient device/worker
@@ -761,6 +855,10 @@ class ServerConfig:
     # Request-scoped distributed tracing: flight-recorder reservoir sizes
     # and metric exemplars (docs/OBSERVABILITY.md).
     trace: TraceConfig = field(default_factory=TraceConfig)
+    # Fleet telemetry plane: time-series history sampler, SLO burn-rate
+    # engine, device-utilization derivation, fleet scrape + deep profiling
+    # (docs/OBSERVABILITY.md "The telemetry plane"). On by default.
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     # Emit one JSON object per log line (machine-ingestible) instead of the
     # human-readable default.
     log_json: bool = False
@@ -820,6 +918,7 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     model_dicts = raw.pop("model", [])
     dist_dict = raw.pop("distributed", None)
     trace_dict = raw.pop("trace", None)
+    telemetry_dict = raw.pop("telemetry", None)
     parallel_dict = raw.pop("parallel", None)
     genserve_dict = raw.pop("genserve", None)
     scheduler_dict = raw.pop("scheduler", None)
@@ -831,11 +930,21 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     cache_dict = raw.pop("cache", None)
     adaptive_dict = raw.pop("adaptive", None)
     cfg: ServerConfig = _build(ServerConfig, raw)
-    cfg.models = [_build(ModelConfig, m) for m in model_dicts]
+    models = []
+    for m in model_dicts:
+        # [model.slo] is a nested sub-table of its [[model]] entry.
+        slo_dict = m.pop("slo", None)
+        mc = _build(ModelConfig, m)
+        if slo_dict is not None:
+            mc.slo = _build(SloConfig, slo_dict)
+        models.append(mc)
+    cfg.models = models
     if dist_dict is not None:
         cfg.distributed = _build(DistributedConfig, dist_dict)
     if trace_dict is not None:
         cfg.trace = _build(TraceConfig, trace_dict)
+    if telemetry_dict is not None:
+        cfg.telemetry = _build(TelemetryConfig, telemetry_dict)
     if parallel_dict is not None:
         cfg.parallel = _build(ParallelConfig, parallel_dict)
     if genserve_dict is not None:

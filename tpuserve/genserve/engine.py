@@ -124,6 +124,10 @@ class GenEngine:
         # Fleet device-time ledger hook (tpuserve.scheduler): called with
         # each compiled step's seconds when a scheduler is attached.
         self.device_time_cb = None
+        # Device-seconds ledger (ISSUE 14): the engine is single-mesh, so
+        # all step time lands on the replica-0 row; the telemetry sampler
+        # derives device_utilization{model=,replica=0} from its rate.
+        self._c_device_seconds = metrics.device_seconds_counter(name, 0)
         self._pending: collections.deque[_GenRequest] = collections.deque()
         self._state: Any = None
         self._state_struct: Any = None
@@ -339,6 +343,7 @@ class GenEngine:
                                       iteration=info.iterations)
                 self._h_step.observe(step_ms, trace_id=ex_tid)
                 self._observe_step(step_ms)
+                self._c_device_seconds.inc(step_ms / 1e3)
                 if self.device_time_cb is not None:
                     self.device_time_cb(step_ms / 1e3)
                 self._c_iterations.inc()

@@ -533,6 +533,12 @@ PRIORITIES = ("interactive", "batch")
 # lifecycle path, warm = serving.
 MODEL_STATES = {"cold": 0.0, "warming": 1.0, "warm": 2.0}
 
+# SLO alert states as gauge values (slo_alert_state{model=...}), chosen —
+# like BREAKER_STATES — so "bigger = less healthy" reads naturally on a
+# dashboard (tpuserve.telemetry.slo; the /alerts endpoint carries the
+# same vocabulary as strings).
+SLO_ALERT_STATES = {"ok": 0.0, "pending": 1.0, "firing": 2.0}
+
 # Reasons on sched_sheds_total{model=,reason=} (tpuserve.scheduler):
 # "deadline_unmeetable" — the stamped deadline provably cannot be met at
 # admission (fast 504, Clockwork P3); "priority_shed" — batch-class work
@@ -719,6 +725,41 @@ class Metrics:
         device-time ledger in monotonic form."""
         return self.counter(f"sched_device_seconds_total{{model={model}}}")
 
+    def device_seconds_counter(self, model: str, replica: int) -> Counter:
+        """device_seconds_total{model=,replica=}: cumulative device-section
+        seconds (dispatch-to-ready) one runtime replica spent serving this
+        model — the per-chip form of the device-time ledger. The telemetry
+        sampler divides its windowed rate by wall time to derive
+        device_utilization{model=,replica=} (docs/OBSERVABILITY.md "The
+        telemetry plane"). Prebound at batcher/engine start — never call
+        per batch."""
+        return self.counter(
+            f"device_seconds_total{{model={model},replica={replica}}}")
+
+    def device_utilization_gauge(self, model: str, replica: int) -> Gauge:
+        """device_utilization{model=,replica=}: fraction of wall time one
+        chip spent in this model's device sections over the
+        [telemetry] utilization window (0.0 idle .. ~1.0 saturated;
+        derived by the sampler from device_seconds_total). Summed across
+        models per replica it is that chip's total occupancy — the number
+        the roofline's ceiling math needs to be honest about."""
+        return self.gauge(
+            f"device_utilization{{model={model},replica={replica}}}")
+
+    def slo_burn_gauge(self, model: str, window_s: float) -> Gauge:
+        """slo_burn_rate{model=,window=}: the model's error-budget burn
+        rate over one [telemetry] burn window (bad fraction / budget;
+        1.0 = spending the budget exactly at the sustainable pace).
+        Updated every sampler tick (tpuserve.telemetry.slo)."""
+        return self.gauge(
+            f"slo_burn_rate{{model={model},window={window_s:g}s}}")
+
+    def set_slo_alert_state(self, model: str, state: str) -> None:
+        """slo_alert_state{model=}: the /alerts state as a gauge
+        (SLO_ALERT_STATES: ok 0 / pending 1 / firing 2)."""
+        self.gauge(f"slo_alert_state{{model={model}}}").set(
+            SLO_ALERT_STATES[state])
+
     def set_model_state(self, model: str, state: str) -> None:
         """model_state{model=}: the warm/cold paging state as a gauge
         (MODEL_STATES: cold 0 / warming 1 / warm 2)."""
@@ -780,6 +821,11 @@ class Metrics:
                          f'{_ex(len(h.bounds))}')
             lines.append(f"{base}_sum{{{labels.rstrip(',')}}} {snap['total']}")
             lines.append(f"{base}_count{{{labels.rstrip(',')}}} {snap['n']}")
+        # OpenMetrics terminator (ISSUE 14 satellite): a scraper that
+        # understands OpenMetrics treats a missing `# EOF` as a truncated
+        # (torn) scrape; plain Prometheus parsers read it as a comment, so
+        # it is emitted unconditionally.
+        lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def summary(self) -> dict:
@@ -810,6 +856,26 @@ class Metrics:
                 row["saturated"] = True
             out["latency"][name] = row
         return out
+
+
+# Exposition content types for /metrics content negotiation (ISSUE 14
+# satellite): the OpenMetrics type is served when the client's Accept
+# header asks for it (Prometheus ≥ 2.5 does), the classic text type
+# otherwise. The BODY is identical either way — the exposition this
+# registry renders (`name_total` counters, `# TYPE` metadata, exemplar
+# syntax, `# EOF`) is valid under both parsers.
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def exposition_content_type(accept: str | None) -> str:
+    """Negotiate the /metrics Content-Type from the request's Accept
+    header: OpenMetrics when explicitly acceptable, classic text format
+    otherwise (including no/wildcard Accept — maximum compatibility)."""
+    if accept and "application/openmetrics-text" in accept:
+        return OPENMETRICS_CONTENT_TYPE
+    return PROMETHEUS_CONTENT_TYPE
 
 
 def _escape_label(value: str) -> str:

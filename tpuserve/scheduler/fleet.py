@@ -113,6 +113,12 @@ class FleetScheduler:
         self.metrics = metrics
         self._entries: dict[str, _Entry] = {}
         self._sweep_task: asyncio.Task | None = None
+        # SLO engine reference (ISSUE 14, tpuserve.telemetry.slo), set by
+        # the server when [telemetry] runs: slo_state() reads each model's
+        # live burn-rate alert (ok/pending/firing). This is the documented
+        # shed-on-burn seam — a future PR sheds batch-class work for a
+        # FIRING model instead of waiting for fleet-wide saturation.
+        self.slo = None
 
     # -- registration ---------------------------------------------------------
     def register(self, name: str, batcher: Any, mcfg: Any,
@@ -286,6 +292,12 @@ class FleetScheduler:
         """Record model activity (the idle-demotion clock)."""
         self._entries[model].last_used = time.monotonic()
 
+    def slo_state(self, model: str) -> str:
+        """The model's live SLO alert state ("ok"/"pending"/"firing";
+        "ok" when no engine is attached or the model has no objective) —
+        the burn-rate signal admission policy can act on."""
+        return self.slo.state_of(model) if self.slo is not None else "ok"
+
     # -- warm/cold state machine ----------------------------------------------
     def is_warm(self, model: str) -> bool:
         e = self._entries.get(model)
@@ -398,6 +410,7 @@ class FleetScheduler:
             pred = self.predict_completion_s(name)
             models[name] = {
                 "state": e.state,
+                "slo_alert": self.slo_state(name),
                 "priority": e.mcfg.priority,
                 "cold_start": e.mcfg.cold_start,
                 "share": round(self.share(name), 4),

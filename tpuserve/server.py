@@ -70,9 +70,12 @@ from tpuserve.genserve import GenEngine
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
 from tpuserve.obs import (PRIORITIES, FlightRecorder, Metrics, TraceContext,
-                          spans_to_chrome)
+                          exposition_content_type, spans_to_chrome)
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
 from tpuserve.scheduler import FleetScheduler
+from tpuserve.telemetry import (MetricSampler, ProfileCapture, SloEngine,
+                                TimeSeriesStore, UtilizationDeriver)
+from tpuserve.telemetry.profile import CaptureBusy
 
 log = logging.getLogger("tpuserve.server")
 
@@ -199,6 +202,29 @@ class ServerState:
         self.scheduler = (FleetScheduler(cfg.scheduler, self.metrics)
                           if cfg.scheduler.enabled else None)
         self.canary_ok: dict[str, bool] = {}
+        # Telemetry plane (ISSUE 14, docs/OBSERVABILITY.md "The telemetry
+        # plane"): bounded time-series history over every metric, the SLO
+        # burn-rate engine over [model.slo] objectives, device-utilization
+        # derivation, and on-demand deep profiling. All None when
+        # [telemetry] enabled = false.
+        self.store: TimeSeriesStore | None = None
+        self.sampler: MetricSampler | None = None
+        self.slo: SloEngine | None = None
+        self.util: UtilizationDeriver | None = None
+        self.profiler: ProfileCapture | None = None
+        if cfg.telemetry.enabled:
+            tcfg = cfg.telemetry
+            self.store = TimeSeriesStore(
+                self.metrics,
+                capacity=int(tcfg.history_s / tcfg.sample_interval_s))
+            self.slo = SloEngine(self.metrics, self.store,
+                                 tcfg.burn_windows_s)
+            self.util = UtilizationDeriver(self.metrics, self.store,
+                                           tcfg.utilization_window_s)
+            self.sampler = MetricSampler(
+                self.store, tcfg.sample_interval_s,
+                hooks=[self.slo.tick, self.util.tick])
+            self.profiler = ProfileCapture(self.metrics)
         # The event loop that owns the batchers/engines/cache/scheduler
         # (set in start()). Handlers running on a parallel ingest loop
         # (cfg.ingest_loops > 1) hop their submission onto it; on the main
@@ -399,6 +425,20 @@ class ServerState:
         # counter (Counter.inc is locked — decode threads and ingest loops
         # may call this concurrently).
         preproc.set_native_fallback_hook(self._note_native_fallback)
+        if self.slo is not None:
+            # SLO registration: models whose [model.slo] names a latency
+            # objective get burn-rate gauges + an /alerts row; the rest
+            # are simply not evaluated.
+            for mcfg in self.cfg.models:
+                self.slo.register(mcfg.name, mcfg.slo)
+        if self.scheduler is not None:
+            # Shed-on-burn seam (ISSUE 14): the scheduler can read each
+            # model's live alert state (FleetScheduler.slo) — future PRs
+            # shed batch-class work while a model burns budget instead of
+            # waiting for fleet saturation.
+            self.scheduler.slo = self.slo
+        if self.sampler is not None:
+            self.sampler.start()
         if self.scheduler is not None:
             await self.scheduler.start()
         if self.cfg.startup_canary:
@@ -510,6 +550,14 @@ class ServerState:
             # warm-up) must not mutate model state under the drain.
             await self.scheduler.stop()
         self.begin_drain()
+        if self.sampler is not None:
+            # The telemetry sampler joins during the drain too (no orphan
+            # thread ticking a dying registry) — but AFTER the draining
+            # flag: it only READS metrics, so it is not revival machinery,
+            # and admission must close before anything that can suspend.
+            # stop() is idempotent for the non-drain teardown path.
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.sampler.stop)
         # Early-retire deferred epochs so pending futures resolve in
         # readback time instead of at the epoch deadline.
         for rt in self.runtimes.values():
@@ -539,6 +587,13 @@ class ServerState:
                     str(list(b)): v
                     for b, v in sorted(rt.raw_ms_per_batch.items())},
             }
+            if self.util is not None:
+                # Chip-occupancy context (ISSUE 14): the roofline's ceiling
+                # percentages read differently at 0.2 vs 0.9 utilization —
+                # carry the live busy fractions beside the raw-ms terms.
+                u = self.util.stats().get(name)
+                if u:
+                    row["utilization"] = u
             raw_vals = [v for v in rt.raw_ms_per_batch.values() if v]
             if raw_vals:
                 # The largest probed bucket prices the split: it is what a
@@ -617,6 +672,9 @@ class ServerState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
+        if self.sampler is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.sampler.stop)
         if self.scheduler is not None:
             await self.scheduler.stop()
         for lc in self.lifecycles.values():
@@ -1004,8 +1062,80 @@ async def handle_healthz(request: web.Request) -> web.Response:
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
+    """GET /metrics — Prometheus/OpenMetrics exposition. The body always
+    ends with the OpenMetrics ``# EOF`` terminator; the Content-Type is
+    negotiated from the Accept header (ISSUE 14 satellite)."""
     state: ServerState = request.app[STATE_KEY]
-    return web.Response(text=state.metrics.render_prometheus(), content_type="text/plain")
+    ctype = exposition_content_type(request.headers.get("Accept"))
+    return web.Response(
+        body=state.metrics.render_prometheus().encode("utf-8"),
+        headers={"Content-Type": ctype})
+
+
+async def handle_history(request: web.Request) -> web.Response:
+    """GET /stats/history?metric=&window_s= — time-resolved metric history
+    from the telemetry rings: raw samples plus derived counter rates and
+    histogram window-delta quantiles (docs/OBSERVABILITY.md "The telemetry
+    plane"). Without ``metric=``, lists the recorded series names.
+    ``metric=`` may be a full labeled name or a bare base name (every
+    matching series is returned)."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.store is None:
+        return _err(409, "[telemetry] is disabled; no history is recorded")
+    metric = request.query.get("metric")
+    if not metric:
+        return web.json_response({"metrics": state.store.metric_names(),
+                                  **state.store.stats()})
+    try:
+        window_s = (float(request.query["window_s"])
+                    if "window_s" in request.query else None)
+        if window_s is not None and window_s <= 0:
+            raise ValueError(window_s)
+    except (TypeError, ValueError):
+        return _err(400, "window_s must be a positive number")
+    names = state.store.match(metric)
+    if not names:
+        return _err(404, f"no recorded series matches {metric!r} "
+                         "(GET /stats/history lists the inventory)")
+    series = [state.store.history(n, window_s) for n in names]
+    return web.json_response(
+        {"series": [s for s in series if s is not None]})
+
+
+async def handle_alerts(request: web.Request) -> web.Response:
+    """GET /alerts — the SLO engine's burn-rate alert states: per model
+    ok/pending/firing with live burn per window. Models without a
+    [model.slo] latency objective are absent; with [telemetry] disabled
+    the endpoint says so instead of guessing."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.slo is None:
+        return _err(409, "[telemetry] is disabled; no SLO evaluation runs")
+    return web.json_response(state.slo.alerts())
+
+
+async def handle_profile(request: web.Request) -> web.Response:
+    """POST /debug/profile?duration_ms= — arm a jax.profiler device trace
+    for the window and answer ONE merged Chrome trace: device lanes (pids
+    >= 1000) beside the span ring's serving-path events from the same
+    window. 409 while a capture is already armed; device-trace
+    unavailability degrades (the span half still answers), never 5xx."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.profiler is None:
+        return _err(409, "[telemetry] is disabled; profiling is not armed")
+    try:
+        duration_ms = float(request.query.get("duration_ms", "500"))
+    except (TypeError, ValueError):
+        return _err(400, "duration_ms must be a number")
+    if not (1.0 <= duration_ms <= state.cfg.telemetry.profile_max_ms):
+        return _err(400, f"duration_ms must be in [1, "
+                         f"{state.cfg.telemetry.profile_max_ms:g}], "
+                         f"got {duration_ms:g}")
+    try:
+        merged = await state.profiler.capture(duration_ms)
+    except CaptureBusy:
+        return _err(409, "a profile capture is already armed "
+                         "(jax.profiler is one-at-a-time)")
+    return web.json_response(merged)
 
 
 async def handle_stats(request: web.Request) -> web.Response:
@@ -1035,6 +1165,25 @@ async def handle_stats(request: web.Request) -> web.Response:
     # errored span trees are retained per model (the trees themselves live
     # at /debug/slow and /debug/trace?trace_id=).
     out["trace"] = state.recorder.stats()
+    # Telemetry plane (docs/OBSERVABILITY.md "The telemetry plane"):
+    # sampler heartbeat + ring occupancy, per-chip device utilization, and
+    # profiling state. History itself lives at /stats/history, alerts at
+    # /alerts.
+    if state.store is not None:
+        out["telemetry"] = {
+            **state.store.stats(),
+            "sample_interval_s": state.cfg.telemetry.sample_interval_s,
+            "profile": state.profiler.stats()
+            if state.profiler is not None else None,
+        }
+    if state.util is not None:
+        util = state.util.stats()
+        if util:
+            out["utilization"] = util
+    if state.slo is not None:
+        alerts = state.slo.alerts()
+        if alerts["models"]:
+            out["slo"] = alerts
     if witness.enabled():
         # Observed lock-order graph + any violations (docs/ANALYSIS.md).
         out["robustness"]["lock_witness"] = witness.snapshot()
@@ -1322,6 +1471,12 @@ def make_app(state: ServerState, loop_index: int = 0,
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/stats", _main_loop_handler(handle_stats))
+    # Telemetry plane (ISSUE 14): history + alerts read the sampler's own
+    # locked structures (safe from any loop); profiling arms process-global
+    # jax.profiler state and is cheapest kept off the ingest loops.
+    app.router.add_get("/stats/history", handle_history)
+    app.router.add_get("/alerts", handle_alerts)
+    app.router.add_post("/debug/profile", _main_loop_handler(handle_profile))
     app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/debug/slow", handle_slow)
     app.router.add_get("/", handle_index)

@@ -1,0 +1,31 @@
+"""Fleet telemetry plane (ISSUE 14; docs/OBSERVABILITY.md "The telemetry
+plane").
+
+Four pieces, all fed by the one metric registry every process already
+owns (tpuserve.obs.Metrics):
+
+- ``store``   — bounded per-metric time-series rings + the background
+  sampler thread that fills them (``GET /stats/history``);
+- ``slo``     — the multi-window burn-rate engine over ``[model.slo]``
+  objectives (``slo_burn_rate`` gauges, ``GET /alerts``), plus the
+  device-utilization derivation;
+- ``fleet``   — exposition parse/merge for the router's fleet scrape
+  (``GET /metrics/fleet`` / ``/stats/fleet``);
+- ``profile`` — on-demand jax.profiler device-trace capture merged with
+  the span ring (``POST /debug/profile``).
+"""
+
+from tpuserve.telemetry.fleet import merge_expositions, parse_exposition
+from tpuserve.telemetry.profile import ProfileCapture
+from tpuserve.telemetry.slo import SloEngine, UtilizationDeriver
+from tpuserve.telemetry.store import MetricSampler, TimeSeriesStore
+
+__all__ = [
+    "MetricSampler",
+    "ProfileCapture",
+    "SloEngine",
+    "TimeSeriesStore",
+    "UtilizationDeriver",
+    "merge_expositions",
+    "parse_exposition",
+]

@@ -61,8 +61,11 @@ from tpuserve.analysis import witness
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig
 from tpuserve.faults import CircuitBreaker, Watchdog
-from tpuserve.obs import FlightRecorder, Metrics, TraceContext, spans_to_chrome
+from tpuserve.obs import (FlightRecorder, Metrics, TraceContext,
+                          exposition_content_type, spans_to_chrome)
 from tpuserve.server import _err, _requested_timeout_ms, configure_logging
+from tpuserve.telemetry import (MetricSampler, SloEngine, TimeSeriesStore,
+                                merge_expositions, parse_exposition)
 from tpuserve.workerproc.hosts import HostSupervisor, host_name
 from tpuserve.workerproc.peers import (
     HashRing,
@@ -228,6 +231,30 @@ class RouterState:
         self._inflight = 0
         self.serving_addresses: list = []
         self._session: aiohttp.ClientSession | None = None
+        # Telemetry plane, router tier (ISSUE 14): history over the
+        # router's own registry plus the SLO engine evaluated over
+        # router_latency_ms{model=} — the CLIENT-observed latency, queue +
+        # retries + hedges included, which is the tier an availability SLO
+        # is honestly judged at. The fleet scrape (/metrics/fleet) is
+        # assembled on demand from workers + peers, below.
+        self.store: TimeSeriesStore | None = None
+        self.sampler: MetricSampler | None = None
+        self.slo: SloEngine | None = None
+        if cfg.telemetry.enabled:
+            tcfg = cfg.telemetry
+            self.store = TimeSeriesStore(
+                self.metrics,
+                capacity=int(tcfg.history_s / tcfg.sample_interval_s))
+            self.slo = SloEngine(
+                self.metrics, self.store, tcfg.burn_windows_s,
+                metric_fmt="router_latency_ms{{model={name}}}")
+            self.sampler = MetricSampler(self.store, tcfg.sample_interval_s,
+                                         hooks=[self.slo.tick])
+            for mcfg in cfg.models:
+                self.slo.register(mcfg.name, mcfg.slo)
+        self.fleet_scrapes = self.metrics.counter("fleet_scrapes_total")
+        self.fleet_scrape_errors = self.metrics.counter(
+            "fleet_scrape_errors_total")
         for mcfg in cfg.models:
             name = mcfg.name
             self.handles[name] = RouterHandles(name, mcfg, self.metrics)
@@ -250,6 +277,8 @@ class RouterState:
         if witness.maybe_install():
             log.info("lock witness installed (TPUSERVE_LOCK_WITNESS)")
         self._session = aiohttp.ClientSession()
+        if self.sampler is not None:
+            self.sampler.start()
         if not self.is_primary:
             # Peer router: bind the peer listener (cache hops land here).
             # The topology sync is sequenced by _peer_serve AFTER the ready
@@ -342,6 +371,9 @@ class RouterState:
         this drain is about to SIGTERM), stop admitting, then wait for
         every in-flight relay to resolve within the budget."""
         await self.watchdog.stop()
+        if self.sampler is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.sampler.stop)
         self.begin_drain()
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         while self._inflight > 0 and time.monotonic() < deadline:
@@ -350,6 +382,9 @@ class RouterState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
+        if self.sampler is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.sampler.stop)
         if self.topo is not None:
             await self.topo.stop()
         if self.peer_sup is not None:
@@ -729,6 +764,112 @@ class RouterState:
             await self._broadcast_generation(name)
         return (200 if ok else 409), {"workers": per_worker}
 
+    # -- fleet scrape (ISSUE 14) ---------------------------------------------
+    async def _scrape_one(self, proc: str, url: str) -> tuple[str, str | None]:
+        """Scrape one source's /metrics; None = stale (counted, never an
+        error up the stack — a dead host is data)."""
+        timeout = aiohttp.ClientTimeout(
+            total=self.cfg.telemetry.fleet_timeout_ms / 1e3)
+        try:
+            async with self._session.get(url, timeout=timeout) as r:
+                if r.status != 200:
+                    self.fleet_scrape_errors.inc()
+                    return proc, None
+                return proc, await r.text()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — stale-marked, never 5xx
+            self.fleet_scrape_errors.inc()
+            return proc, None
+
+    async def scrape_fleet(self) -> list[tuple[str, str | None]]:
+        """Every process's exposition, stale-marked where unreachable:
+        this router, every CONFIGURED worker slot (a dead host's workers
+        scrape as stale, exactly the degradation the merge must survive),
+        and — on the primary — every configured peer router."""
+        self.fleet_scrapes.inc()
+        jobs: list = []
+        sources: list[tuple[str, str | None]] = [
+            (f"router{self.router_id}", self.metrics.render_prometheus())]
+        for wid in range(self.supervisor.n):
+            w = self.supervisor.worker_by_id(wid)
+            if w is None:
+                sources.append((f"worker{wid}", None))
+            else:
+                jobs.append(self._scrape_one(f"worker{wid}",
+                                             f"{w.base_url}/metrics"))
+        if self.is_primary and self.peer_sup is not None:
+            members = self.peer_sup.members()
+            for rid in range(1, self.rcfg.routers):
+                url = members.get(rid)
+                if url is None:
+                    sources.append((f"router{rid}", None))
+                else:
+                    jobs.append(self._scrape_one(f"router{rid}",
+                                                 f"{url}/peer/metrics"))
+        if jobs:
+            sources.extend(await asyncio.gather(*jobs))
+        return sources
+
+    def fleet_rollup(self, sources: list[tuple[str, str | None]],
+                     merged: str) -> dict:
+        """The /stats/fleet body: per-source liveness, down failure
+        domains, and per-model fleet-summed serving counters with true
+        fleet latency quantiles from the bucket-merged histogram."""
+        from tpuserve.telemetry.store import quantile_from_counts
+
+        per_model: dict[str, dict] = {
+            n: {"requests_total": 0.0, "items_total": 0.0,
+                "batches_total": 0.0, "deadline_exceeded_total": 0.0}
+            for n in self.handles}
+        hist: dict[str, dict[float, float]] = {}
+        parsed = parse_exposition(merged)
+        for base, labels, value in parsed["samples"]:
+            if base == "latency_ms_bucket" and 'phase="total"' in labels:
+                for n in per_model:
+                    if f'model="{n}"' in labels:
+                        le = next((p[3:].strip('"')
+                                   for p in labels.split(",")
+                                   if p.startswith("le=")), None)
+                        if le is not None:
+                            b = (float("inf") if le == "+Inf"
+                                 else float(le))
+                            hist.setdefault(n, {})[b] = value
+                continue
+            row_key = base if base in ("requests_total", "items_total",
+                                       "batches_total",
+                                       "deadline_exceeded_total") else None
+            if row_key is None:
+                continue
+            for n, row in per_model.items():
+                if f'model="{n}"' in labels:
+                    row[row_key] += value
+        for n, buckets in hist.items():
+            bounds = sorted(b for b in buckets if math.isfinite(b))
+            cum = [buckets[b] for b in bounds] + \
+                [buckets.get(float("inf"), 0.0)]
+            # cumulative -> per-bucket deltas for the quantile math
+            deltas = [cum[0]] + [max(0.0, cum[i] - cum[i - 1])
+                                 for i in range(1, len(cum))]
+            p50 = quantile_from_counts(bounds, deltas, 0.5)
+            p99 = quantile_from_counts(bounds, deltas, 0.99)
+            per_model[n]["fleet_latency_p50_ms"] = \
+                round(p50, 3) if p50 is not None and math.isfinite(p50) \
+                else None
+            per_model[n]["fleet_latency_p99_ms"] = \
+                round(p99, 3) if p99 is not None and math.isfinite(p99) \
+                else None
+        out = {
+            "sources": {proc: ("up" if text is not None else "stale")
+                        for proc, text in sources},
+            "stale": sorted(p for p, t in sources if t is None),
+            "down_domains": self.supervisor.down_domains(),
+            "models": per_model,
+            "scrapes_total": int(self.fleet_scrapes.value),
+            "scrape_errors_total": int(self.fleet_scrape_errors.value),
+        }
+        return out
+
 
 # -- handlers ----------------------------------------------------------------
 
@@ -997,9 +1138,108 @@ async def handle_healthz(request: web.Request) -> web.Response:
 
 
 async def handle_metrics(request: web.Request) -> web.Response:
+    """Router /metrics: same OpenMetrics envelope + content negotiation as
+    the single-process server (ISSUE 14 satellite)."""
     state: RouterState = request.app[ROUTER_KEY]
-    return web.Response(text=state.metrics.render_prometheus(),
-                        content_type="text/plain")
+    ctype = exposition_content_type(request.headers.get("Accept"))
+    return web.Response(
+        body=state.metrics.render_prometheus().encode("utf-8"),
+        headers={"Content-Type": ctype})
+
+
+async def handle_router_history(request: web.Request) -> web.Response:
+    """GET /stats/history on the router: the router tier's own series
+    (router_latency_ms, relay/hedge counters, supervision gauges) from
+    its telemetry rings — same query surface as the worker endpoint."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if state.store is None:
+        return _err(409, "[telemetry] is disabled; no history is recorded")
+    metric = request.query.get("metric")
+    if not metric:
+        return web.json_response({"metrics": state.store.metric_names(),
+                                  **state.store.stats()})
+    try:
+        window_s = (float(request.query["window_s"])
+                    if "window_s" in request.query else None)
+        if window_s is not None and window_s <= 0:
+            raise ValueError(window_s)
+    except (TypeError, ValueError):
+        return _err(400, "window_s must be a positive number")
+    names = state.store.match(metric)
+    if not names:
+        return _err(404, f"no recorded series matches {metric!r} "
+                         "(GET /stats/history lists the inventory)")
+    series = [state.store.history(n, window_s) for n in names]
+    return web.json_response(
+        {"series": [s for s in series if s is not None]})
+
+
+async def handle_router_alerts(request: web.Request) -> web.Response:
+    """GET /alerts on the router: burn-rate states over the CLIENT-
+    observed latency (router_latency_ms — retries, hedges, and queue time
+    included), which is the tier an availability SLO is honestly judged
+    at."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if state.slo is None:
+        return _err(409, "[telemetry] is disabled; no SLO evaluation runs")
+    return web.json_response(state.slo.alerts())
+
+
+async def handle_fleet_metrics(request: web.Request) -> web.Response:
+    """GET /metrics/fleet — ONE merged exposition for the whole fleet:
+    counters summed across every process, gauges labeled ``proc=``,
+    histograms merged bucket-wise (exact — bucket bounds are shared).
+    Unreachable sources are stale-marked (``fleet_source_up`` 0 + a
+    ``# STALE`` comment); a dead host NEVER makes this endpoint 5xx.
+    Peer routers proxy to the primary — one process owns the scrape."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(state, "GET",
+                                             "/peer/fleet/metrics")
+    sources = await state.scrape_fleet()
+    ctype = exposition_content_type(request.headers.get("Accept"))
+    return web.Response(body=merge_expositions(sources).encode("utf-8"),
+                        headers={"Content-Type": ctype})
+
+
+async def handle_fleet_stats(request: web.Request) -> web.Response:
+    """GET /stats/fleet — the JSON rollup of the same scrape: per-source
+    up/stale, down failure domains, and per-model fleet-summed counters
+    with true fleet latency quantiles from the merged buckets."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(state, "GET",
+                                             "/peer/fleet/stats")
+    sources = await state.scrape_fleet()
+    merged = merge_expositions(sources)
+    return web.json_response(state.fleet_rollup(sources, merged))
+
+
+async def handle_worker_history(request: web.Request) -> web.Response:
+    """GET /workers/{wid}/stats/history — operator passthrough to one
+    worker's history endpoint (workers bind loopback), query included."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        wid = int(request.match_info["wid"])
+    except ValueError:
+        return _err(400, "worker id must be an integer")
+    if not 0 <= wid < state.supervisor.n:
+        return _err(404, f"no worker slot {wid}")
+    w = state.supervisor.worker_by_id(wid)
+    if w is None:
+        return _err(503, f"worker {wid} is down (respawning)")
+    try:
+        async with state._session.get(
+                f"{w.base_url}/stats/history",
+                params=dict(request.query),
+                timeout=aiohttp.ClientTimeout(total=10.0)) as r:
+            raw = await r.read()
+            return web.Response(body=raw, status=r.status,
+                                content_type=r.content_type or "text/plain")
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:  # noqa: BLE001
+        return _err(503, f"worker {wid} unreachable: {e}")
 
 
 async def handle_stats(request: web.Request) -> web.Response:
@@ -1038,6 +1278,18 @@ async def handle_stats(request: web.Request) -> web.Response:
         "workers_per_domain": state.rcfg.workers,
     }
     out["trace"] = state.recorder.stats()
+    # Telemetry plane (ISSUE 14): sampler heartbeat + the router-tier SLO
+    # view (burn over client-observed latency). History at /stats/history,
+    # the fleet merge at /metrics/fleet + /stats/fleet.
+    if state.store is not None:
+        out["telemetry"] = {
+            **state.store.stats(),
+            "sample_interval_s": state.cfg.telemetry.sample_interval_s,
+        }
+    if state.slo is not None:
+        alerts = state.slo.alerts()
+        if alerts["models"]:
+            out["slo"] = alerts
     if state.caches:
         out["cache"] = {n: c.stats() for n, c in state.caches.items()}
     return web.json_response(out)
@@ -1305,6 +1557,12 @@ def make_peer_app(state: RouterState) -> web.Application:
     app.router.add_get("/peer/admin/{name}/versions", handle_versions)
     app.router.add_get("/peer/stats", handle_stats)
     app.router.add_get("/peer/healthz", handle_healthz)
+    # Telemetry (ISSUE 14): /peer/metrics is what the PRIMARY scrapes for
+    # the fleet merge (a peer's own registry); the /peer/fleet/* pair is
+    # the proxy target peers forward their public fleet endpoints to.
+    app.router.add_get("/peer/metrics", handle_metrics)
+    app.router.add_get("/peer/fleet/metrics", handle_fleet_metrics)
+    app.router.add_get("/peer/fleet/stats", handle_fleet_stats)
     return app
 
 
@@ -1330,10 +1588,17 @@ def make_router_app(state: RouterState,
     app.router.add_post("/admin/models/{name}:reload", handle_reload)
     app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
     app.router.add_get("/admin/models/{name}/versions", handle_versions)
+    app.router.add_get("/workers/{wid}/stats/history", handle_worker_history)
     app.router.add_get("/workers/{wid}/{page}", handle_worker_proxy)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
+    # Telemetry plane (ISSUE 14): router-tier history/alerts + the fleet
+    # scrape (peers proxy the fleet endpoints to the primary).
+    app.router.add_get("/metrics/fleet", handle_fleet_metrics)
     app.router.add_get("/stats", handle_stats)
+    app.router.add_get("/stats/history", handle_router_history)
+    app.router.add_get("/stats/fleet", handle_fleet_stats)
+    app.router.add_get("/alerts", handle_router_alerts)
     app.router.add_get("/debug/slow", handle_slow)
     app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/", handle_index)
