@@ -47,6 +47,9 @@ scripts/trace_smoke.sh
 echo "== telemetry smoke (fleet sum exact, burn-rate alert fires + clears, history, compile delta 0) =="
 scripts/telemetry_smoke.sh
 
+echo "== events smoke (SIGKILL postmortem with stderr tail + snapshot, audited fleet reload, trace-event interleave, compile delta 0) =="
+scripts/events_smoke.sh
+
 echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
 scripts/worker_drill.sh
 

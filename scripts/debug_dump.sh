@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Failure-forensics dump (ISSUE 15 satellite): called from a smoke
+# script's failure path with the live server's base URL, pulls the flight
+# data — /debug/events, /debug/postmortems, /debug/slow (+ /stats) — into
+# $TPUSERVE_CI_DUMP_DIR so CI can upload it as an artifact and a red run
+# is diagnosable without a rerun. Best-effort by design: the server may
+# already be dead, and a dump failure must never mask the real failure.
+#   usage: debug_dump.sh <base_url> [label]
+set -u
+BASE="${1:?usage: debug_dump.sh <base_url> [label]}"
+LABEL="${2:-smoke}"
+OUTDIR="${TPUSERVE_CI_DUMP_DIR:-/tmp/tpuserve-ci-dumps}/${LABEL}-$$"
+mkdir -p "$OUTDIR" || exit 0
+echo "debug_dump: pulling flight data from $BASE into $OUTDIR" >&2
+for page in "debug/events" "debug/postmortems" "debug/slow" \
+            "debug/audit" "stats"; do
+  fname="${page//\//_}.json"
+  curl -fsS --max-time 10 "$BASE/$page" -o "$OUTDIR/$fname" 2>/dev/null \
+    || echo "unreachable: $BASE/$page" > "$OUTDIR/$fname.unreachable"
+done
+exit 0

@@ -1,0 +1,259 @@
+#!/usr/bin/env bash
+# Events smoke (ISSUE 15): a REAL router over 2 host failure domains
+# (1 worker each) under closed-loop load, gating the flight-data contract
+# (docs/OBSERVABILITY.md "The third pillar"):
+#   1. one worker is SIGKILLed mid-load — /debug/postmortems names the
+#      injected signal and carries a non-empty stderr tail (the dead
+#      process's capture file) plus its black-box event snapshot;
+#   2. after the domain re-absorbs, a fleet :reload appears in
+#      /debug/audit with per-host outcomes and the bumped generation;
+#   3. /debug/trace?trace_id= for a recorded slow request (injected
+#      worker_slow) interleaves >= 1 correlated event by trace id;
+#   4. /debug/events answers on the router AND through the
+#      /workers/{wid}/debug/events proxy, and junk query params 400;
+#   5. the SURVIVOR worker's runtime_compiles_total delta is exactly 0
+#      across the whole drama (forensics perturb no variant registry).
+# On failure, scripts/debug_dump.sh pulls the flight data for CI upload —
+# the event plane diagnosing its own red run.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export TPUSERVE_LOCK_WITNESS=1
+
+PORT=18671
+TMPD="$(mktemp -d /tmp/events_smoke_XXXX)"
+CFG="$TMPD/cfg.toml"
+cat > "$CFG" <<EOF
+host = "127.0.0.1"
+port = $PORT
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+watchdog_interval_s = 0.2
+
+[trace]
+slow_n = 8
+error_capacity = 64
+
+[events]
+dir = "$TMPD/blackbox"
+snapshot_interval_s = 0.3
+
+[router]
+enabled = true
+hosts = 2
+workers = 1
+retry_max = 3
+health_interval_s = 0.2
+respawn_initial_s = 0.3
+respawn_max_s = 2.0
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+
+[faults]
+enabled = true
+seed = 5
+
+[[faults.rule]]
+kind = "worker_slow"
+model = "toy"
+probability = 1.0
+count = 1
+delay_ms = 300.0
+EOF
+
+python -m tpuserve serve --config "$CFG" &
+SERVER_PID=$!
+cleanup() {
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    scripts/debug_dump.sh "http://127.0.0.1:$PORT" events_smoke || true
+  fi
+  kill -9 "$SERVER_PID" 2>/dev/null || true
+  rm -rf "$TMPD"
+}
+trap cleanup EXIT
+
+for _ in $(seq 1 120); do
+  if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then break; fi
+  sleep 0.5
+done
+curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null
+
+# Victim = worker 0 (host0); survivor = worker 1 (host1). The survivor's
+# compile-delta window opens BEFORE the load + kill + reload drama.
+VICTIM_PID="$(python - <<'EOF'
+import json, urllib.request
+s = json.load(urllib.request.urlopen("http://127.0.0.1:18671/stats"))
+row = next(w for w in s["workers"]["workers"] if w["worker"] == 0)
+print(row["pid"])
+EOF
+)"
+curl -fsS "http://127.0.0.1:$PORT/workers/1/metrics" > "$TMPD/w1_before.txt"
+
+# Closed-loop load in the background (the worker_slow rules fire on each
+# worker's first request -> the recorded slow tail), SIGKILL mid-load.
+python - "$TMPD/load.json" <<'EOF' &
+import io, json, sys, threading, time, urllib.request
+import numpy as np
+
+buf = io.BytesIO()
+np.save(buf, np.random.default_rng(1).integers(0, 255, (8, 8, 3),
+                                               dtype=np.uint8))
+payload = buf.getvalue()
+ok, err = [0], [0]
+stop_at = time.monotonic() + 7.0
+
+def loop(i):
+    while time.monotonic() < stop_at:
+        req = urllib.request.Request(
+            "http://127.0.0.1:18671/v1/models/toy:predict", data=payload,
+            headers={"Content-Type": "application/x-npy"})
+        try:
+            with urllib.request.urlopen(req, timeout=15) as r:
+                r.read()
+                ok[0] += 1
+        except Exception:
+            err[0] += 1
+        time.sleep(0.01)
+
+threads = [threading.Thread(target=loop, args=(i,)) for i in range(4)]
+for t in threads: t.start()
+for t in threads: t.join()
+json.dump({"ok": ok[0], "err": err[0]}, open(sys.argv[1], "w"))
+EOF
+LOAD_PID=$!
+
+sleep 2
+echo "SIGKILL victim worker 0 (pid $VICTIM_PID) mid-load"
+kill -9 "$VICTIM_PID"
+wait "$LOAD_PID"
+echo "load: $(cat "$TMPD/load.json")"
+
+python - "$TMPD" <<'EOF'
+import json, sys, time, urllib.request, urllib.error
+
+tmpd = sys.argv[1]
+base = "http://127.0.0.1:18671"
+
+
+def get(path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+load = json.load(open(f"{tmpd}/load.json"))
+total = load["ok"] + load["err"]
+assert load["ok"] > 0 and load["err"] / max(1, total) < 0.10, load
+
+# -- 1. postmortem names the SIGKILL, with stderr tail + snapshot -----------
+rec = None
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    _, body = get("/debug/postmortems")
+    sk = [r for r in body["postmortems"] if r.get("signal") == "SIGKILL"]
+    if sk:
+        rec = sk[0]
+        break
+    time.sleep(0.2)
+assert rec is not None, "no SIGKILL postmortem recorded"
+assert rec["component"] == "worker" and rec["exitcode"] == -9, rec
+assert rec.get("stderr_tail"), "postmortem carries no stderr tail"
+snap = rec.get("snapshot")
+assert snap and snap.get("events"), "postmortem carries no event snapshot"
+print(f"postmortem OK: {rec['id']} signal={rec['signal']} "
+      f"stderr_tail={len(rec['stderr_tail'])}B "
+      f"snapshot_events={len(snap['events'])}")
+
+# -- wait for the domain to re-absorb (reload refuses while degraded) -------
+deadline = time.monotonic() + 60.0
+while time.monotonic() < deadline:
+    _, s = get("/stats")
+    if s["workers"]["healthy"] == 2 and not s["workers"].get("hosts_up", 2) < 2:
+        break
+    time.sleep(0.2)
+_, s = get("/stats")
+assert s["workers"]["healthy"] == 2, s["workers"]
+
+# -- 2. fleet reload lands in the audit trail with per-host outcomes --------
+status, body = get("/stats")
+req = urllib.request.Request(f"{base}/admin/models/toy:reload", data=b"",
+                             method="POST")
+with urllib.request.urlopen(req, timeout=120) as r:
+    reload_body = json.loads(r.read())
+    assert r.status == 200, reload_body
+_, audit = get("/debug/audit")
+arec = next(a for a in audit["audit"] if a["verb"] == "reload")
+assert arec["outcome"] == "ok" and arec["target"] == "toy", arec
+assert arec.get("per_host"), f"no per-host outcomes on the audit: {arec}"
+assert set(arec["per_host"]) == {"host0", "host1"}, arec
+assert arec["generation"] >= 2 and arec["duration_ms"] > 0, arec
+print(f"audit OK: reload gen={arec['generation']} "
+      f"per_host={sorted(arec['per_host'])}")
+
+# -- 3. slow-trace <-> event interleave by trace id -------------------------
+_, slow = get("/debug/slow")
+recs = [r for rows in slow["slow"].values() for r in rows
+        if r["duration_ms"] >= 250.0]
+assert recs, f"no recorded slow request >= 250ms: {slow['slow']}"
+tid = recs[0]["trace_id"]
+_, tr = get(f"/debug/trace?trace_id={tid}&format=record")
+evs = tr.get("events") or []
+assert any(e.get("trace_id") == tid for e in evs), \
+    f"trace {tid} interleaves no correlated event: {evs}"
+with urllib.request.urlopen(f"{base}/debug/trace?trace_id={tid}",
+                            timeout=30) as r:
+    chrome = json.loads(r.read())
+assert any(e["ph"] == "i" for e in chrome["traceEvents"]), \
+    "no instant events in the Chrome artifact"
+print(f"interleave OK: trace {tid[:8]}… carries "
+      f"{sum(1 for e in evs if e.get('trace_id') == tid)} correlated "
+      "event(s)")
+
+# -- 4. /debug/events surfaces + junk-param 400s ----------------------------
+status, ev = get("/debug/events")
+assert status == 200 and ev["events"] and ev["size"] > 0
+status, _ = get("/debug/events?level=loud")
+assert status == 400, "junk level must 400"
+status, wev = get("/workers/1/debug/events")
+assert status == 200 and wev["events"], "worker events proxy failed"
+assert all(e["pid"] == 2 for e in wev["events"]), "worker 1 lane must be 2"
+print(f"events OK: router ring {ev['size']} records, worker proxy "
+      f"{len(wev['events'])} records")
+EOF
+
+# -- 5. survivor compile delta 0 --------------------------------------------
+curl -fsS "http://127.0.0.1:$PORT/workers/1/metrics" > "$TMPD/w1_after.txt"
+python - "$TMPD" <<'EOF'
+import sys
+
+def compiles(path):
+    total = 0.0
+    for line in open(path):
+        if line.startswith("runtime_compiles_total"):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+tmpd = sys.argv[1]
+before = compiles(f"{tmpd}/w1_before.txt")
+after = compiles(f"{tmpd}/w1_after.txt")
+assert after - before == 0, \
+    f"survivor recompiled: {before} -> {after}"
+print(f"compile delta OK: survivor {before} -> {after} (delta 0)")
+EOF
+
+kill -TERM "$SERVER_PID" 2>/dev/null || true
+wait "$SERVER_PID" 2>/dev/null || true
+echo "events smoke OK"

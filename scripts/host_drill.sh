@@ -26,13 +26,18 @@ export TPUSERVE_LOCK_WITNESS=1
 
 CFG="$(mktemp /tmp/tpuserve_host_drill.XXXXXX.toml)"
 OUT="$(mktemp /tmp/tpuserve_host_drill.XXXXXX.json)"
-trap 'rm -f "$CFG" "$OUT"' EXIT
+BB="$(mktemp -d /tmp/tpuserve_host_drill_bb.XXXXXX)"
+trap 'rm -f "$CFG" "$OUT"; rm -rf "$BB"' EXIT
 
-cat > "$CFG" <<'EOF'
+cat > "$CFG" <<EOF
 decode_threads = 2
 startup_canary = false
 drain_timeout_s = 5.0
 watchdog_interval_s = 0.2
+
+[events]
+dir = "$BB"
+snapshot_interval_s = 0.3
 
 [router]
 enabled = true
@@ -82,6 +87,20 @@ assert s["router"]["retries_total"] >= 1, \
 deltas = s["compile_deltas"]
 assert deltas and all(d == 0 for d in deltas.values()), \
     f"surviving workers recompiled: {deltas}"
+# Postmortem evidence (ISSUE 15): killpg'ing a whole domain must leave a
+# host-level record naming the SIGKILL, with the agent's stderr tail and
+# the lost workers' black-box snapshots read from their slot files (the
+# dead agent can't report them over the pipe).
+pms = [p for p in s.get("postmortems", [])
+       if p.get("signal") == "SIGKILL" and p.get("component") == "host"]
+assert pms, f"no host SIGKILL postmortem: {s.get('postmortems')}"
+pm = pms[0]
+assert pm["id"] == f"host{kill['killed_host']}", pm
+assert pm.get("workers_lost") == kill["workers_killed"], pm
+assert pm.get("stderr_tail"), "host postmortem carries no agent stderr tail"
+assert any(wrow.get("snapshot") and wrow["snapshot"].get("events")
+           for wrow in pm.get("workers", [])), \
+    "no lost worker's black-box snapshot survived the host kill"
 print(f"host drill OK: availability {s['availability']}, "
       f"host {kill['killed_host']} ({kill['workers_killed']} workers) "
       f"re-absorbed in {kill['reabsorb_s']}s, "

@@ -44,7 +44,17 @@ EOF
 
 python -m tpuserve serve --config "$CFG" &
 SERVER_PID=$!
-trap 'kill -9 $SERVER_PID 2>/dev/null || true; rm -rf "$TMPD"' EXIT
+cleanup() {
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    # Red-run forensics (ISSUE 15): dump the live flight data so CI can
+    # upload it as an artifact — diagnosable without a rerun.
+    scripts/debug_dump.sh "http://127.0.0.1:$PORT" ingest_smoke || true
+  fi
+  kill -9 "$SERVER_PID" 2>/dev/null || true
+  rm -rf "$TMPD"
+}
+trap cleanup EXIT
 
 for _ in $(seq 1 60); do
   if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then break; fi

@@ -66,7 +66,17 @@ EOF
 
 python -m tpuserve serve --config "$CFG" &
 SERVER_PID=$!
-trap 'kill -9 $SERVER_PID 2>/dev/null || true; rm -rf "$TMPD"' EXIT
+cleanup() {
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    # Red-run forensics (ISSUE 15): dump the live flight data so CI can
+    # upload it as an artifact — diagnosable without a rerun.
+    scripts/debug_dump.sh "http://127.0.0.1:$PORT" trace_smoke || true
+  fi
+  kill -9 "$SERVER_PID" 2>/dev/null || true
+  rm -rf "$TMPD"
+}
+trap cleanup EXIT
 
 for _ in $(seq 1 120); do
   if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then break; fi
@@ -224,7 +234,7 @@ print(f"trace smoke OK: {load['throughput_per_s']:.1f} req/s, "
       f"({rec['duration_ms']:.0f} ms), exemplars parse, compile delta 0")
 EOF
 
-kill -TERM $SERVER_PID
-wait $SERVER_PID 2>/dev/null || true
+kill -TERM "$SERVER_PID"
+wait "$SERVER_PID" 2>/dev/null || true
 trap 'rm -rf "$TMPD"' EXIT
 echo "trace smoke OK"

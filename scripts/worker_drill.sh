@@ -19,12 +19,17 @@ export TPUSERVE_LOCK_WITNESS=1
 
 CFG="$(mktemp /tmp/tpuserve_worker_drill.XXXXXX.toml)"
 OUT="$(mktemp /tmp/tpuserve_worker_drill.XXXXXX.json)"
-trap 'rm -f "$CFG" "$OUT"' EXIT
+BB="$(mktemp -d /tmp/tpuserve_worker_drill_bb.XXXXXX)"
+trap 'rm -f "$CFG" "$OUT"; rm -rf "$BB"' EXIT
 
-cat > "$CFG" <<'EOF'
+cat > "$CFG" <<EOF
 decode_threads = 2
 startup_canary = false
 drain_timeout_s = 5.0
+
+[events]
+dir = "$BB"
+snapshot_interval_s = 0.3
 
 [router]
 enabled = true
@@ -67,10 +72,24 @@ assert s["workers"]["healthy"] == 2, s["workers"]
 assert s["workers"]["deaths_total"] == 1, s["workers"]
 assert s["router"]["retries_total"] >= 1, \
     "the SIGKILL mid-load should have forced at least one router retry"
+# Postmortem evidence (ISSUE 15): the drill summary must carry a record
+# naming the injected SIGKILL, with the victim's stderr tail and its
+# black-box event snapshot — Chaos Eng P6: the injected failure must be
+# diagnosable from the artifact alone.
+pms = [p for p in s.get("postmortems", []) if p.get("signal") == "SIGKILL"]
+assert pms, f"no SIGKILL postmortem in the drill summary: {s.get('postmortems')}"
+pm = pms[0]
+assert pm["component"] == "worker" and pm["pid"] == kill["killed_pid"], pm
+assert pm.get("stderr_tail"), "postmortem carries no stderr tail"
+assert pm.get("snapshot") and pm["snapshot"].get("events"), \
+    "postmortem carries no black-box event snapshot"
 print(f"worker drill OK: availability {s['availability']}, "
       f"respawn {kill['respawn_s']}s, "
       f"{int(s['router']['retries_total'])} retries absorbed, "
-      f"{integ['validated']} validated responses, 0 torn")
+      f"{integ['validated']} validated responses, 0 torn, "
+      f"postmortem names {pm['signal']} with "
+      f"{len(pm['stderr_tail'])}B stderr + "
+      f"{len(pm['snapshot']['events'])} snapshot events")
 EOF
 
 echo "worker drill OK"

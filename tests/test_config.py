@@ -419,3 +419,39 @@ def test_trace_block_validation():
         TraceConfig(slow_n=-1)
     with pytest.raises(ValueError, match="error_capacity"):
         TraceConfig(error_capacity=-1)
+
+
+def test_events_block(tmp_path):
+    p = tmp_path / "events.toml"
+    p.write_text(
+        """
+[events]
+capacity = 128
+jsonl_path = "/tmp/ev.jsonl"
+bridge_level = "WARNING"
+dir = "/tmp/bb"
+snapshot_interval_s = 0.5
+stderr_tail_bytes = 1024
+audit_capacity = 32
+postmortem_capacity = 8
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.events.enabled is True
+    assert cfg.events.capacity == 128
+    assert cfg.events.jsonl_path == "/tmp/ev.jsonl"
+    assert cfg.events.bridge_level == "WARNING"
+    assert cfg.events.dir == "/tmp/bb"
+    assert cfg.events.snapshot_interval_s == 0.5
+    assert cfg.events.stderr_tail_bytes == 1024
+    assert cfg.events.audit_capacity == 32
+    assert cfg.events.postmortem_capacity == 8
+    # Defaults + dot-path override.
+    cfg2 = load_config(None, overrides=["events.enabled=false"])
+    assert cfg2.events.enabled is False
+    assert cfg2.events.capacity == 4096
+    assert cfg2.events.stderr_path == "" and cfg2.events.snapshot_path == ""

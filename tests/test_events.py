@@ -1,0 +1,547 @@
+"""Structured event plane, black box, and audit trail (ISSUE 15).
+
+Layers, the test_trace discipline:
+
+- pure units: event-ring bounds + newest-kept ordering, query filtering,
+  the stdlib-logging bridge, audit/postmortem ledgers (counters, FIFO
+  bounds, signal naming), the black-box writer's atomic checkpoints, and
+  the /debug/events query validator;
+- HTTP e2e on a real single-process server: /debug/events carries bridged
+  log lines and trace-correlated request events, junk query params 400
+  (the /debug/trace hardening), a rejected reload leaves an audit record
+  naming the failing gate, and /debug/trace?trace_id= interleaves the
+  matching events into both the record and the Chrome output;
+- a REAL 2-worker router fleet: SIGKILL one worker and the supervisor's
+  postmortem names the signal, carries the dead worker's stderr tail
+  (boot banner included) and its black-box snapshot, and the fleet
+  :reload lands in /debug/audit with per-worker outcomes.
+
+No pytest-asyncio in the image: module-level event loops drive everything
+explicitly (the test_router idiom).
+"""
+
+import asyncio
+import io
+import json
+import logging
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from tpuserve.config import (EventsConfig, FaultRuleConfig, FaultsConfig,
+                             ModelConfig, RouterConfig, ServerConfig,
+                             TraceConfig)
+from tpuserve.obs import Metrics
+from tpuserve.telemetry.events import (AuditLog, BlackBoxWriter, EventLog,
+                                       EventLogBridge, PostmortemLog,
+                                       events_to_chrome, install_bridge,
+                                       parse_events_query, read_snapshot,
+                                       read_tail, signal_name)
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning")
+
+NPY = "application/x-npy"
+
+
+def npy_bytes(seed: int = 0) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.random.default_rng(seed).integers(
+        0, 255, (8, 8, 3), dtype=np.uint8))
+    return buf.getvalue()
+
+
+def _toy(name: str = "toy", **kw) -> ModelConfig:
+    base = dict(family="toy", batch_buckets=[1, 2], deadline_ms=2.0,
+                dtype="float32", num_classes=10, parallelism="single",
+                request_timeout_ms=10_000.0, wire_size=8)
+    base.update(kw)
+    return ModelConfig(name=name, **base)
+
+
+# ---------------------------------------------------------------------------
+# Pure units
+# ---------------------------------------------------------------------------
+
+def test_ring_bounds_and_newest_kept_ordering():
+    el = EventLog(Metrics(16), capacity=8)
+    for i in range(20):
+        el.emit("info", "test", f"e{i}", seq=i)
+    evs = el.query()
+    # bounded at capacity, oldest dropped, order preserved oldest-first
+    assert len(evs) == 8
+    assert [e["fields"]["seq"] for e in evs] == list(range(12, 20))
+    # limit keeps the NEWEST matches; limit=0 is empty, not everything
+    assert [e["fields"]["seq"] for e in el.query(limit=3)] == [17, 18, 19]
+    assert el.query(limit=0) == []
+    # monotone timestamps
+    ts = [e["ts_us"] for e in evs]
+    assert ts == sorted(ts)
+
+
+def test_query_filters_compose():
+    el = EventLog(Metrics(16), capacity=64)
+    el.emit("info", "http", "a", trace_id="aa" * 16)
+    el.emit("warning", "http", "b", trace_id="bb" * 16)
+    el.emit("warning", "lifecycle", "c")
+    mid = el.query()[-1]["ts_us"]
+    el.emit("error", "http", "d", trace_id="bb" * 16)
+    assert [e["event"] for e in el.query(level="warning")] == ["b", "c"]
+    assert [e["event"] for e in el.query(subsystem="http")] == ["a", "b", "d"]
+    assert [e["event"] for e in el.query(trace_id="bb" * 16)] == ["b", "d"]
+    assert [e["event"] for e in el.query(since_us=mid)] == ["c", "d"]
+    assert [e["event"] for e in el.query(subsystem="http",
+                                         trace_id="bb" * 16,
+                                         level="error")] == ["d"]
+
+
+def test_events_logged_counters_split_by_level_and_subsystem():
+    m = Metrics(16)
+    el = EventLog(m, capacity=16)
+    el.emit("info", "http", "x")
+    el.emit("info", "http", "y")
+    el.emit("error", "batcher", "z")
+    cv = m.counter_values()
+    assert cv["events_logged_total{level=info,subsystem=http}"] == 2.0
+    assert cv["events_logged_total{level=error,subsystem=batcher}"] == 1.0
+
+
+def test_logging_bridge_captures_existing_tpuserve_logger():
+    """The point of the bridge: an EXISTING `log = logging.getLogger(
+    "tpuserve.lifecycle")` call site flows into the ring with no rewrite —
+    subsystem from the logger suffix, level mapped, message rendered."""
+    el = EventLog(Metrics(16), capacity=16)
+    install_bridge(el, "INFO")
+    try:
+        logging.getLogger("tpuserve.lifecycle").warning(
+            "reload rejected at %s gate", "integrity")
+        logging.getLogger("tpuserve.workerproc").info("worker %d up", 3)
+        logging.getLogger("tpuserve.lifecycle").debug("below bridge_level")
+        evs = el.query()
+        assert [(e["subsystem"], e["level"]) for e in evs] == [
+            ("lifecycle", "warning"), ("workerproc", "info")]
+        assert evs[0]["msg"] == "reload rejected at integrity gate"
+    finally:
+        logging.getLogger("tpuserve").handlers.clear()
+
+
+def test_bridge_never_raises():
+    class Boom:
+        def emit(self, *a, **k):
+            raise RuntimeError("ring on fire")
+
+    h = EventLogBridge(Boom())
+    rec = logging.LogRecord("tpuserve.x", logging.INFO, __file__, 1,
+                            "msg", None, None)
+    h.emit(rec)  # swallowed: a logging handler must never take logging down
+
+
+def test_parse_events_query_hardening():
+    ok = parse_events_query({"since_us": "12.5", "level": "warning",
+                             "subsystem": "http", "trace_id": "ab",
+                             "limit": "7"})
+    assert ok == {"since_us": 12.5, "level": "warning", "subsystem": "http",
+                  "trace_id": "ab", "limit": 7}
+    assert parse_events_query({}) == {"limit": 1000}
+    for junk in ({"level": "loud"}, {"since_us": "yesterday"},
+                 {"limit": "many"}, {"limit": "-1"}, {"bogus": "1"}):
+        with pytest.raises(ValueError):
+            parse_events_query(junk)
+
+
+def test_audit_log_fifo_and_counters():
+    m = Metrics(16)
+    au = AuditLog(m, capacity=2)
+    au.record("reload", "toy", "ok", duration_ms=10.0, version=2)
+    au.record("reload", "toy", "rejected", stage="integrity")
+    au.record("drain", "server", "ok")
+    dump = au.dump()  # newest first, bounded
+    assert [r["verb"] for r in dump] == ["drain", "reload"]
+    assert dump[1]["stage"] == "integrity"
+    cv = m.counter_values()
+    assert cv["audit_events_total{verb=reload,outcome=ok}"] == 1.0
+    assert cv["audit_events_total{verb=reload,outcome=rejected}"] == 1.0
+    assert cv["audit_events_total{verb=drain,outcome=ok}"] == 1.0
+
+
+def test_postmortem_capture_reads_tail_and_snapshot(tmp_path):
+    m = Metrics(16)
+    el = EventLog(m, capacity=16)
+    pm = PostmortemLog(m, capacity=4, tail_bytes=32, events=el)
+    stderr = tmp_path / "w0.stderr"
+    stderr.write_text("x" * 100 + "final words")
+    snap = tmp_path / "w0.snapshot.json"
+    snap.write_text(json.dumps({"events": [{"event": "e"}], "pid": 7}))
+    rec = pm.capture_blocking("worker", "worker0", 1234, -signal.SIGKILL,
+                              stderr_path=str(stderr),
+                              snapshot_path=str(snap), worker=0)
+    assert rec["signal"] == "SIGKILL" and rec["exitcode"] == -9
+    assert rec["stderr_tail"].endswith("final words")
+    assert len(rec["stderr_tail"]) <= 32  # tail, not the whole file
+    assert rec["snapshot"]["pid"] == 7
+    assert m.counter_values()[
+        "postmortems_total{component=worker,signal=SIGKILL}"] == 1.0
+    # mirrored into the event ring for the flight data
+    assert any(e["event"] == "postmortem" for e in el.query())
+    # missing files degrade to None fields, never raise
+    rec2 = pm.capture_blocking("worker", "worker1", 1, 0,
+                               stderr_path=str(tmp_path / "nope"),
+                               snapshot_path=str(tmp_path / "nope2"))
+    assert rec2["signal"] is None and rec2["stderr_tail"] is None \
+        and rec2["snapshot"] is None
+    assert signal_name(-signal.SIGTERM) == "SIGTERM"
+    assert read_tail(None, 10) is None and read_snapshot(None) is None
+
+
+def test_blackbox_writer_atomic_and_initial_snapshot(tmp_path):
+    path = str(tmp_path / "sub" / "snap.json")
+    calls = []
+
+    def collect():
+        calls.append(1)
+        return {"n": len(calls)}
+
+    bb = BlackBoxWriter(path, interval_s=30.0, collect=collect)
+    bb.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not os.path.exists(path) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # one snapshot immediately at start (a kill right after boot still
+        # has evidence), atomic (no .tmp left behind)
+        assert json.load(open(path)) == {"n": 1}
+        assert not os.path.exists(path + ".tmp")
+    finally:
+        bb.stop()
+    assert not bb.is_alive()
+    # a collect() that raises skips the tick rather than killing the thread
+    bad = BlackBoxWriter(str(tmp_path / "bad.json"), 30.0,
+                         lambda: (_ for _ in ()).throw(RuntimeError()))
+    bad.write_once()
+    assert not os.path.exists(str(tmp_path / "bad.json"))
+
+
+def test_events_to_chrome_instant_events():
+    el = EventLog(Metrics(16), capacity=8, pid=3)
+    el.emit("warning", "http", "request_error", model="toy",
+            trace_id="cd" * 16, status=500)
+    (ev,) = events_to_chrome(el.query())
+    assert ev["ph"] == "i" and ev["pid"] == 3
+    assert ev["name"] == "http:request_error"
+    assert ev["args"]["trace_id"] == "cd" * 16
+    assert ev["args"]["status"] == 500
+
+
+def test_events_config_validation():
+    with pytest.raises(ValueError, match="capacity"):
+        EventsConfig(capacity=0)
+    with pytest.raises(ValueError, match="bridge_level"):
+        EventsConfig(bridge_level="LOUD")
+    with pytest.raises(ValueError, match="snapshot_interval_s"):
+        EventsConfig(snapshot_interval_s=-1.0)
+    EventsConfig(bridge_level="warning")  # case-insensitive ok
+
+
+# ---------------------------------------------------------------------------
+# Over HTTP: single-process server
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+@pytest.fixture(scope="module")
+def client(loop, tmp_path_factory):
+    from tpuserve.server import ServerState, make_app
+
+    snap = str(tmp_path_factory.mktemp("events") / "snap.json")
+    cfg = ServerConfig(
+        models=[_toy()],
+        decode_threads=2,
+        trace=TraceConfig(slow_n=8, error_capacity=32),
+        events=EventsConfig(snapshot_interval_s=0.2, snapshot_path=snap),
+        faults=FaultsConfig(enabled=True, rules=[
+            FaultRuleConfig(kind="reload_corrupt", model="toy",
+                            probability=1.0),
+        ]),
+    )
+    state = ServerState(cfg)
+    state.build()
+    app = make_app(state)
+
+    async def setup():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    c = loop.run_until_complete(setup())
+    yield lambda coro: loop.run_until_complete(coro), c, state, snap
+    loop.run_until_complete(c.close())
+
+
+def test_debug_events_carries_bridged_and_request_events(client):
+    run, c, state, _ = client
+
+    async def go():
+        # A 400 (garbage body) leaves a trace-correlated request_error.
+        resp = await c.post("/v1/models/toy:predict", data=b"junk",
+                            headers={"Content-Type": NPY})
+        assert resp.status == 400
+        tid = resp.headers["X-Trace-Id"]
+        r = await c.get("/debug/events")
+        assert r.status == 200
+        body = await r.json()
+        assert body["size"] > 0 and body["capacity"] == 4096
+        evs = body["events"]
+        # bridged startup log lines flowed in (server subsystem at least)
+        assert any(e["event"] == "log" for e in evs)
+        mine = [e for e in evs if e.get("trace_id") == tid]
+        assert mine and mine[0]["event"] == "request_error"
+        assert mine[0]["fields"]["status"] == 400
+        # filter down over HTTP
+        r = await c.get(f"/debug/events?trace_id={tid}&subsystem=http")
+        filt = (await r.json())["events"]
+        assert len(filt) == 1 and filt[0]["trace_id"] == tid
+
+    run(go())
+
+
+def test_debug_events_junk_params_400(client):
+    run, c, state, _ = client
+
+    async def go():
+        for q in ("level=loud", "since_us=yesterday", "limit=many",
+                  "limit=-2", "bogus=1"):
+            r = await c.get(f"/debug/events?{q}")
+            assert r.status == 400, q
+            assert "error" in await r.json()
+
+    run(go())
+
+
+def test_rejected_reload_leaves_audit_record(client):
+    run, c, state, _ = client
+
+    async def go():
+        r = await c.post("/admin/models/toy:reload")
+        assert r.status == 409  # reload_corrupt @ 100% -> integrity gate
+        r = await c.get("/debug/audit")
+        assert r.status == 200
+        audit = (await r.json())["audit"]
+        rec = next(a for a in audit if a["verb"] == "reload")
+        assert rec["target"] == "toy" and rec["outcome"] == "rejected"
+        assert rec["stage"] == "integrity"
+        assert rec["duration_ms"] >= 0
+        # the lifecycle's structured rejection event landed too
+        r = await c.get("/debug/events?subsystem=lifecycle")
+        evs = (await r.json())["events"]
+        assert any(e["event"] == "reload_rejected"
+                   and e["fields"]["stage"] == "integrity" for e in evs)
+
+    run(go())
+
+
+def test_trace_event_interleave_by_trace_id(client):
+    run, c, state, _ = client
+
+    async def go():
+        resp = await c.post("/v1/models/toy:predict", data=b"junk",
+                            headers={"Content-Type": NPY})
+        tid = resp.headers["X-Trace-Id"]
+        # record format: spans AND correlated events on one record
+        r = await c.get(f"/debug/trace?trace_id={tid}&format=record")
+        assert r.status == 200
+        rec = await r.json()
+        assert rec["spans"] and rec["events"]
+        assert all(e["trace_id"] == tid for e in rec["events"])
+        # chrome format: the events ride as instant marks beside the spans
+        r = await c.get(f"/debug/trace?trace_id={tid}")
+        trace = json.loads(await r.text())
+        phases = {e["ph"] for e in trace["traceEvents"]}
+        assert phases == {"X", "i"}
+        inst = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+        assert any(e["args"].get("trace_id") == tid for e in inst)
+
+    run(go())
+
+
+def test_blackbox_snapshot_checkpoints(client):
+    run, c, state, snap = client
+
+    async def go():
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            data = read_snapshot(snap)
+            if data and data.get("counters"):
+                break
+            await asyncio.sleep(0.05)
+        data = read_snapshot(snap)
+        assert data is not None, "black box never checkpointed"
+        assert data["pid"] == os.getpid()
+        assert isinstance(data["events"], list) and data["events"]
+        assert any(k.startswith("requests_total")
+                   for k in data["counters"])
+        assert "flight" in data
+
+    run(go())
+
+
+def test_stats_events_block_and_disabled_409(client, loop):
+    run, c, state, _ = client
+
+    async def go():
+        r = await c.get("/stats")
+        block = (await r.json())["events"]
+        assert block["size"] > 0
+        assert "audit" in block and "postmortems" in block
+
+    run(go())
+
+    # disabled plane: endpoints answer 409, nothing is constructed
+    from tpuserve.server import ServerState
+
+    cfg2 = ServerConfig(models=[_toy("t2")],
+                        events=EventsConfig(enabled=False))
+    s2 = ServerState(cfg2)
+    assert s2.events is None and s2.audit is None and s2.postmortems is None
+
+
+# ---------------------------------------------------------------------------
+# The black box end-to-end: a REAL 2-worker fleet, SIGKILL one worker
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet(loop, tmp_path_factory):
+    from aiohttp import web
+
+    from tpuserve.workerproc.router import RouterState, make_router_app
+
+    bb_dir = str(tmp_path_factory.mktemp("blackbox"))
+    cfg = ServerConfig(
+        decode_threads=2, startup_canary=False, drain_timeout_s=3.0,
+        watchdog_interval_s=0.2,
+        router=RouterConfig(enabled=True, workers=2, retry_max=2,
+                            health_interval_s=0.2, unhealthy_after=2,
+                            respawn_initial_s=0.3, respawn_max_s=2.0),
+        events=EventsConfig(dir=bb_dir, snapshot_interval_s=0.2),
+        models=[_toy()],
+    )
+    state = RouterState(cfg)
+    runner = web.AppRunner(make_router_app(state), access_log=None)
+
+    async def setup():
+        await runner.setup()  # on_startup -> supervisor spawns the fleet
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        return runner.addresses[0][1]
+
+    port = loop.run_until_complete(setup())
+    yield (lambda coro: loop.run_until_complete(coro), state, port)
+    loop.run_until_complete(runner.cleanup())
+
+
+async def _fleet_get(port: int, path: str):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://127.0.0.1:{port}{path}",
+                         timeout=aiohttp.ClientTimeout(total=15.0)) as r:
+            return r.status, await r.json()
+
+
+def test_worker_sigkill_leaves_full_postmortem(fleet):
+    """The tentpole black-box contract: SIGKILL a worker mid-life and the
+    reaped slot's postmortem names SIGKILL, carries the dead process's
+    stderr tail (boot banner at minimum — logging writes to stderr), and
+    its last black-box snapshot with events recorded BEFORE death."""
+    run, state, port = fleet
+
+    async def go():
+        import aiohttp
+
+        # serve one request so the worker has flight data to checkpoint
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{port}/v1/models/toy:predict",
+                    data=npy_bytes(), headers={"Content-Type": NPY}) as r:
+                assert r.status == 200
+        # give the 0.2s black box a couple of ticks
+        await asyncio.sleep(0.6)
+        victim = state.supervisor.pick()
+        assert victim is not None
+        os.kill(victim.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 20.0
+        records = []
+        while time.monotonic() < deadline:
+            _, body = await _fleet_get(port, "/debug/postmortems")
+            records = body["postmortems"]
+            if any(r["signal"] == "SIGKILL" for r in records):
+                break
+            await asyncio.sleep(0.1)
+        rec = next(r for r in records if r["signal"] == "SIGKILL")
+        assert rec["component"] == "worker"
+        assert rec["pid"] == victim.pid and rec["exitcode"] == -9
+        assert rec["stderr_tail"], "stderr capture lost"
+        snap = rec["snapshot"]
+        assert snap is not None, "black-box snapshot lost"
+        assert snap["worker_id"] == victim.wid
+        assert isinstance(snap["events"], list) and snap["events"]
+        # the death is flight data on the router too
+        _, body = await _fleet_get(port, "/debug/events?subsystem="
+                                         "supervision")
+        assert any(e["event"] == "postmortem" for e in body["events"])
+        # metric: postmortems_total{component=worker,signal=SIGKILL}
+        assert state.metrics.counter_values()[
+            "postmortems_total{component=worker,signal=SIGKILL}"] >= 1.0
+        # wait for the respawn so the next test sees a whole fleet
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if len(state.supervisor.healthy_workers()) == 2:
+                return
+            await asyncio.sleep(0.1)
+        raise AssertionError("victim never respawned")
+
+    run(go())
+
+
+def test_fleet_reload_lands_in_audit_with_per_worker_outcomes(fleet):
+    run, state, port = fleet
+
+    async def go():
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{port}"
+                              "/admin/models/toy:reload") as r:
+                assert r.status == 200, await r.text()
+        _, body = await _fleet_get(port, "/debug/audit")
+        rec = next(a for a in body["audit"] if a["verb"] == "reload")
+        assert rec["outcome"] == "ok" and rec["target"] == "toy"
+        assert rec["generation"] == state.generations["toy"]
+        assert set(rec["per_worker"]) == {"0", "1"}
+        assert all(v == 200 for v in rec["per_worker"].values())
+
+    run(go())
+
+
+def test_worker_events_proxy(fleet):
+    run, state, port = fleet
+
+    async def go():
+        status, body = await _fleet_get(port, "/workers/0/debug/events")
+        assert status == 200 and body["events"]
+        # worker events ride the worker's process lane (wid + 1)
+        assert all(e["pid"] == 1 for e in body["events"])
+        # junk params 400 straight through the proxy
+        status, _ = await _fleet_get(port,
+                                     "/workers/0/debug/events?level=loud")
+        assert status == 400
+
+    run(go())

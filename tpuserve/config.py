@@ -299,6 +299,68 @@ class TraceConfig:
 
 
 @dataclass
+class EventsConfig:
+    """Structured event plane (``[events]`` TOML; tpuserve.telemetry.events,
+    docs/OBSERVABILITY.md "The third pillar").
+
+    On by default: every process owns a bounded ring of structured event
+    records (ts_us / level / subsystem / event / model / trace correlation
+    ids / free-form fields) fed by explicit emissions AND a stdlib
+    ``logging.Handler`` bridge over the existing ``tpuserve.*`` loggers, so
+    call sites flow in without rewriting. Queryable at ``GET /debug/events``
+    on the server, every worker, and the router. The same block sizes the
+    crash-forensics black box (per-worker stderr capture files + periodic
+    postmortem snapshots, folded into ``GET /debug/postmortems`` on reap)
+    and the admin audit trail (``GET /debug/audit``)."""
+
+    enabled: bool = True
+    # Event records retained in the per-process ring (newest kept).
+    capacity: int = 4096
+    # Optional JSONL file sink: every event appended as one JSON line
+    # ("" disables). The ring is the query surface; the file survives the
+    # process.
+    jsonl_path: str = ""
+    # Minimum stdlib-logging level bridged into the event ring
+    # (DEBUG/INFO/WARNING/ERROR). Explicit emissions ignore this.
+    bridge_level: str = "INFO"
+    # Black-box directory for per-slot stderr capture files and postmortem
+    # snapshots; "" derives a per-deployment default under the system temp
+    # dir (stable across respawns — the supervisor process resolves it
+    # once).
+    dir: str = ""
+    # Per-worker postmortem-snapshot cadence (s): last-N events, flight-
+    # recorder summaries, and key counters checkpointed to the slot's
+    # snapshot file (one snapshot is also written at startup). 0 disables.
+    snapshot_interval_s: float = 2.0
+    # Bytes of a dead process's stderr capture folded into its postmortem
+    # record.
+    stderr_tail_bytes: int = 4096
+    # Admin audit records retained (FIFO beyond it).
+    audit_capacity: int = 256
+    # Postmortem records retained (FIFO beyond it).
+    postmortem_capacity: int = 64
+    # Derived per worker slot by the supervisor (stderr capture file /
+    # snapshot file under `dir`); set explicitly only in tests.
+    stderr_path: str = ""
+    snapshot_path: str = ""
+
+    def __post_init__(self) -> None:
+        if self.capacity < 1 or self.audit_capacity < 1 \
+                or self.postmortem_capacity < 1:
+            raise ValueError(
+                "events.capacity/audit_capacity/postmortem_capacity "
+                "must be >= 1")
+        if self.snapshot_interval_s < 0 or self.stderr_tail_bytes < 0:
+            raise ValueError(
+                "events.snapshot_interval_s/stderr_tail_bytes must be >= 0")
+        if self.bridge_level.upper() not in ("DEBUG", "INFO", "WARNING",
+                                             "ERROR"):
+            raise ValueError(
+                f"events.bridge_level must be DEBUG/INFO/WARNING/ERROR, "
+                f"got {self.bridge_level!r}")
+
+
+@dataclass
 class TelemetryConfig:
     """Fleet telemetry plane (``[telemetry]`` TOML; tpuserve.telemetry,
     docs/OBSERVABILITY.md "The telemetry plane").
@@ -859,6 +921,9 @@ class ServerConfig:
     # engine, device-utilization derivation, fleet scrape + deep profiling
     # (docs/OBSERVABILITY.md "The telemetry plane"). On by default.
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    # Structured event plane + crash-forensics black box + admin audit
+    # trail (docs/OBSERVABILITY.md "The third pillar"). On by default.
+    events: EventsConfig = field(default_factory=EventsConfig)
     # Emit one JSON object per log line (machine-ingestible) instead of the
     # human-readable default.
     log_json: bool = False
@@ -919,6 +984,7 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     dist_dict = raw.pop("distributed", None)
     trace_dict = raw.pop("trace", None)
     telemetry_dict = raw.pop("telemetry", None)
+    events_dict = raw.pop("events", None)
     parallel_dict = raw.pop("parallel", None)
     genserve_dict = raw.pop("genserve", None)
     scheduler_dict = raw.pop("scheduler", None)
@@ -945,6 +1011,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
         cfg.trace = _build(TraceConfig, trace_dict)
     if telemetry_dict is not None:
         cfg.telemetry = _build(TelemetryConfig, telemetry_dict)
+    if events_dict is not None:
+        cfg.events = _build(EventsConfig, events_dict)
     if parallel_dict is not None:
         cfg.parallel = _build(ParallelConfig, parallel_dict)
     if genserve_dict is not None:

@@ -52,6 +52,7 @@ from tpuserve.config import LifecycleConfig
 from tpuserve.obs import Metrics
 from tpuserve.runtime import NaNDetected
 from tpuserve.savedmodel import IntegrityError
+from tpuserve.telemetry import events as events_mod
 from tpuserve.utils.locks import new_async_lock
 
 log = logging.getLogger("tpuserve.lifecycle")
@@ -182,6 +183,11 @@ class ModelLifecycle:
             self._record(version=self.runtime.version, status="live",
                          source=self.model.cfg.weights or "init")
             log.info("%s: published version %d", self.name, self.runtime.version)
+            # Structured twin of the log line (ISSUE 15): version fields a
+            # postmortem/audit reader can machine-match, where the bridge
+            # only carries the rendered message.
+            events_mod.emit("info", "lifecycle", "published",
+                            model=self.name, version=self.runtime.version)
 
             canary_ok = True
             if self._canary is not None:
@@ -235,6 +241,9 @@ class ModelLifecycle:
                      stage=stage, error=str(err))
         log.warning("%s: reload rejected at %s gate: %s; version %d keeps "
                     "serving", self.name, stage, err, self.runtime.version)
+        events_mod.emit("warning", "lifecycle", "reload_rejected",
+                        model=self.name, stage=stage, error=str(err),
+                        version=self.runtime.version)
         raise ReloadRejected(
             f"reload rejected at {stage} gate: {err}", stage=stage) from err
 
@@ -288,6 +297,10 @@ class ModelLifecycle:
                      source=f"rollback({reason})")
         log.warning("%s: rolled back version %d -> %d (%s)", self.name,
                     info["rolled_back_from"], info["version"], reason)
+        events_mod.emit("warning", "lifecycle", "rolled_back",
+                        model=self.name, reason=reason,
+                        version=info["version"],
+                        rolled_back_from=info["rolled_back_from"])
         # Re-canary so /healthz reflects the restored weights and the
         # breaker's recovery path sees a live probe.
         if self._canary is not None:

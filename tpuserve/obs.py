@@ -317,12 +317,18 @@ class TraceContext:
         return self.root_id
 
 
-def spans_to_chrome(spans: Iterable[dict]) -> str:
+def spans_to_chrome(spans: Iterable[dict],
+                    events: Iterable[dict] = ()) -> str:
     """Render recorded span dicts (the TraceContext format) as Chrome
     ``chrome://tracing`` JSON. Each event carries the documented fields —
     name / ph="X" / ts / dur / pid / tid / args — with the trace identity
     (trace_id, span_id, parent_id) folded into args; ``pid`` separates
-    process lanes so a router→worker hop reads as a gap between lanes."""
+    process lanes so a router→worker hop reads as a gap between lanes.
+
+    ``events`` (ISSUE 15) interleaves structured event records from the
+    event plane as instant events (``ph: "i"``,
+    tpuserve.telemetry.events.events_to_chrome) on the same timeline, so
+    one artifact shows what the process was SAYING while the spans ran."""
     out = []
     for s in spans:
         args = dict(s.get("args") or {})
@@ -338,6 +344,10 @@ def spans_to_chrome(spans: Iterable[dict]) -> str:
             "tid": s.get("tid", "req"),
             "args": args,
         })
+    if events:
+        from tpuserve.telemetry.events import events_to_chrome
+
+        out.extend(events_to_chrome(list(events)))
     out.sort(key=lambda e: e["ts"])
     return json.dumps({"traceEvents": out})
 
@@ -403,9 +413,13 @@ class FlightRecorder:
             self._by_id.pop(record["trace_id"], None)
 
     def finish(self, ctx: TraceContext, model: str, status: int,
-               duration_ms: float) -> bool:
-        """Offer one completed request to the reservoirs; True if any
-        retained it. Called once per HTTP request, errors included."""
+               duration_ms: float) -> list[str]:
+        """Offer one completed request to the reservoirs; returns the
+        kinds that retained it (subset of ``["error", "slow"]``, empty =
+        not retained — still truthy-compatible with the old bool). Called
+        once per HTTP request, errors included. The HTTP layer feeds
+        retained-as-slow requests into the event plane so
+        ``/debug/trace?trace_id=`` has events to interleave (ISSUE 15)."""
         kinds: list[str] = []
         with self._lock:
             record: dict | None = None
@@ -439,7 +453,7 @@ class FlightRecorder:
             c = self._counter(model, kind)
             if c is not None:
                 c.inc()
-        return bool(kinds)
+        return kinds
 
     @staticmethod
     def _public(record: dict) -> dict:
@@ -587,6 +601,14 @@ class Metrics:
             if g is None:
                 g = self._gauges[name] = Gauge(name)
             return g
+
+    def counter_values(self) -> dict[str, float]:
+        """Plain name -> value snapshot of every counter (the black-box
+        checkpointer's cheap alternative to summary(), which also prices
+        every histogram's quantiles)."""
+        with self._lock:
+            counters = list(self._counters.items())
+        return {name: c.value for name, c in counters}
 
     # -- convenience --------------------------------------------------------
     def observe_phase(self, model: str, phase: str, ms: float) -> None:

@@ -51,6 +51,7 @@ from typing import Any, Awaitable, Callable
 from tpuserve.batcher import clamp_retry_after_s
 from tpuserve.config import SchedulerConfig
 from tpuserve.obs import PRIORITIES, SCHED_SHED_REASONS, Metrics
+from tpuserve.telemetry import events as events_mod
 
 log = logging.getLogger("tpuserve.scheduler")
 
@@ -367,8 +368,14 @@ class FleetScheduler:
         return True
 
     def _set_state(self, e: _Entry, state: str) -> None:
-        e.state = state
+        prev, e.state = e.state, state
         self.metrics.set_model_state(e.name, state)
+        if prev != state:
+            # Paging transitions are rare and load-bearing — exactly what
+            # the flight data should carry (ISSUE 15): a postmortem reader
+            # can see the victim was mid-warm when it died.
+            events_mod.emit("info", "scheduler", "model_state",
+                            model=e.name, state=state, previous=prev)
 
     async def _sweep_loop(self) -> None:
         while True:

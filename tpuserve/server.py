@@ -73,8 +73,11 @@ from tpuserve.obs import (PRIORITIES, FlightRecorder, Metrics, TraceContext,
                           exposition_content_type, spans_to_chrome)
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
 from tpuserve.scheduler import FleetScheduler
-from tpuserve.telemetry import (MetricSampler, ProfileCapture, SloEngine,
-                                TimeSeriesStore, UtilizationDeriver)
+from tpuserve.telemetry import (AuditLog, BlackBoxWriter, EventLog,
+                                MetricSampler, PostmortemLog, ProfileCapture,
+                                SloEngine, TimeSeriesStore,
+                                UtilizationDeriver)
+from tpuserve.telemetry import events as events_mod
 from tpuserve.telemetry.profile import CaptureBusy
 
 log = logging.getLogger("tpuserve.server")
@@ -225,6 +228,27 @@ class ServerState:
                 self.store, tcfg.sample_interval_s,
                 hooks=[self.slo.tick, self.util.tick])
             self.profiler = ProfileCapture(self.metrics)
+        # Structured event plane (ISSUE 15, docs/OBSERVABILITY.md "The
+        # third pillar"): bounded event ring + logging bridge, admin audit
+        # trail, and the postmortem ledger (populated behind the router
+        # tier by the supervisors; a worker's own log records its view of
+        # the world for the black-box snapshot). All None when [events]
+        # enabled = false.
+        self.events: EventLog | None = None
+        self.audit: AuditLog | None = None
+        self.postmortems: PostmortemLog | None = None
+        self.blackbox: BlackBoxWriter | None = None
+        if cfg.events.enabled:
+            ecfg = cfg.events
+            self.events = EventLog(self.metrics, ecfg.capacity,
+                                   jsonl_path=ecfg.jsonl_path)
+            self.audit = AuditLog(self.metrics, ecfg.audit_capacity,
+                                  events=self.events)
+            self.postmortems = PostmortemLog(
+                self.metrics, ecfg.postmortem_capacity,
+                tail_bytes=ecfg.stderr_tail_bytes, events=self.events)
+            events_mod.install_bridge(self.events, ecfg.bridge_level)
+            events_mod.set_active(self.events)
         # The event loop that owns the batchers/engines/cache/scheduler
         # (set in start()). Handlers running on a parallel ingest loop
         # (cfg.ingest_loops > 1) hop their submission onto it; on the main
@@ -445,7 +469,53 @@ class ServerState:
             await self.run_canaries()
         if self.cfg.canary_interval_s > 0:
             self._canary_task = asyncio.create_task(self._canary_loop())
+        if self.events is not None and self.cfg.events.snapshot_path \
+                and self.cfg.events.snapshot_interval_s > 0:
+            # Black box (ISSUE 15): checkpoint a postmortem snapshot to the
+            # per-slot file (once immediately, then on the interval) so a
+            # SIGKILL at any point after boot leaves last-N events, flight
+            # summaries, and key counters for the supervisor's reap.
+            self.blackbox = BlackBoxWriter(
+                self.cfg.events.snapshot_path,
+                self.cfg.events.snapshot_interval_s,
+                self._blackbox_snapshot)
+            self.blackbox.start()
         self.watchdog.start()
+
+    # Counter families worth carrying in the black-box snapshot: the
+    # serving volume and failure tallies a postmortem reader checks first.
+    _BLACKBOX_COUNTERS = frozenset((
+        "requests_total", "bad_requests_total", "timeouts_total",
+        "deadline_exceeded_total", "batches_total",
+        "watchdog_restarts_total", "events_logged_total"))
+
+    def _blackbox_snapshot(self) -> dict:
+        """One postmortem checkpoint (tpuserve.telemetry.events
+        BlackBoxWriter `collect`): the last-N event records, compact
+        flight-recorder summaries (trace ids, not span trees — the
+        snapshot must stay small), and the key counters. Runs on the
+        black-box thread; everything it reads is locked."""
+        counters = {
+            name: v for name, v in self.metrics.counter_values().items()
+            if name.split("{", 1)[0] in self._BLACKBOX_COUNTERS}
+        slow = []
+        dumped = self.recorder.dump()
+        for model, recs in sorted(dumped.get("slow", {}).items()):
+            slow.extend({"model": model, "trace_id": r["trace_id"],
+                         "status": r["status"],
+                         "duration_ms": r["duration_ms"]}
+                        for r in recs[:4])
+        errors = [{"model": r["model"], "trace_id": r["trace_id"],
+                   "status": r["status"], "duration_ms": r["duration_ms"]}
+                  for r in dumped.get("errors", [])[:8]]
+        return {
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "worker_id": self.worker_id,
+            "events": self.events.tail(50) if self.events is not None else [],
+            "flight": {"slow": slow, "errors": errors},
+            "counters": counters,
+        }
 
     def _note_native_fallback(self, model: str) -> None:
         h = self.handles.get(model)
@@ -543,6 +613,7 @@ class ServerState:
         post-drain sweep could recreate machinery stop() was about to tear
         down (and, for deferred pools, fork a multi-second replacement
         worker nobody would ever use)."""
+        t_drain = time.perf_counter()
         await self.watchdog.stop()
         await self._stop_canary_loop()
         if self.scheduler is not None:
@@ -568,6 +639,17 @@ class ServerState:
         ok = True
         for b in self.batchers.values():
             ok &= await b.drain(deadline)
+        if self.blackbox is not None:
+            # Final checkpoint, then stop: the last snapshot records the
+            # drained state (counters at rest) for whoever reads the slot.
+            await loop.run_in_executor(None, self.blackbox.stop)
+        if self.audit is not None:
+            # Drain is an admin action like any other: the audit trail is
+            # how an operator later tells a rolling restart from a crash.
+            self.audit.record(
+                "drain", "server", "ok" if ok else "budget_expired",
+                duration_ms=(time.perf_counter() - t_drain) * 1e3,
+                drain_timeout_s=self.cfg.drain_timeout_s)
         return ok
 
     def roofline(self, latency_summary: dict) -> dict:
@@ -672,6 +754,9 @@ class ServerState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
+        if self.blackbox is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.blackbox.stop)
         if self.sampler is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.sampler.stop)
@@ -693,6 +778,8 @@ class ServerState:
                 await rt.stop()
         self.stages.shutdown()
         self.pool.shutdown(wait=False, cancel_futures=True)
+        if self.events is not None:
+            self.events.close()  # flush/close the JSONL sink fd
 
 
 # -- handlers ----------------------------------------------------------------
@@ -833,7 +920,21 @@ async def handle_predict(request: web.Request) -> web.Response:
                   status=resp.status)
     if "X-Trace-Id" not in resp.headers:
         resp.headers["X-Trace-Id"] = ctx.trace_id
-    state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    kinds = state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    if state.events is not None:
+        # Trace-correlated flight data (ISSUE 15): errored/shed and
+        # retained-slow requests leave an event carrying the trace id, so
+        # /debug/trace?trace_id= interleaves what the process was saying.
+        if resp.status >= 400:
+            state.events.emit(
+                "error" if resp.status >= 500 else "warning", "http",
+                "request_error", model=name, trace_id=ctx.trace_id,
+                status=resp.status, duration_ms=round(dur_s * 1e3, 3))
+        elif "slow" in kinds:
+            state.events.emit(
+                "info", "http", "slow_request", model=name,
+                trace_id=ctx.trace_id, status=resp.status,
+                duration_ms=round(dur_s * 1e3, 3))
     return resp
 
 
@@ -1130,11 +1231,20 @@ async def handle_profile(request: web.Request) -> web.Response:
         return _err(400, f"duration_ms must be in [1, "
                          f"{state.cfg.telemetry.profile_max_ms:g}], "
                          f"got {duration_ms:g}")
+    t0 = time.perf_counter()
     try:
         merged = await state.profiler.capture(duration_ms)
     except CaptureBusy:
+        if state.audit is not None:
+            state.audit.record("profile", "server", "busy",
+                               requested_ms=duration_ms)
         return _err(409, "a profile capture is already armed "
                          "(jax.profiler is one-at-a-time)")
+    if state.audit is not None:
+        state.audit.record(
+            "profile", "server", "ok",
+            duration_ms=(time.perf_counter() - t0) * 1e3,
+            requested_ms=duration_ms)
     return web.json_response(merged)
 
 
@@ -1165,6 +1275,16 @@ async def handle_stats(request: web.Request) -> web.Response:
     # errored span trees are retained per model (the trees themselves live
     # at /debug/slow and /debug/trace?trace_id=).
     out["trace"] = state.recorder.stats()
+    # Structured event plane (docs/OBSERVABILITY.md "The third pillar"):
+    # ring occupancy + per-level/subsystem tallies, audit/postmortem
+    # ledger sizes. The records themselves live at /debug/events,
+    # /debug/audit, /debug/postmortems.
+    if state.events is not None:
+        out["events"] = {
+            **state.events.stats(),
+            "audit": state.audit.stats(),
+            "postmortems": state.postmortems.stats(),
+        }
     # Telemetry plane (docs/OBSERVABILITY.md "The telemetry plane"):
     # sampler heartbeat + ring occupancy, per-chip device utilization, and
     # profiling state. History itself lives at /stats/history, alerts at
@@ -1246,7 +1366,9 @@ async def handle_trace(request: web.Request) -> web.Response:
 
     ``?trace_id=`` pulls ONE recorded request's complete span tree from the
     flight recorder (``&format=record`` returns the raw record instead —
-    the router tier stitches worker records into one cross-process trace).
+    the router tier stitches worker records into one cross-process trace),
+    with matching structured events interleaved (``events`` key on the
+    record; instant ``ph: "i"`` marks in the Chrome output — ISSUE 15).
     Without it, the span ring is dumped, bounded by ``?limit=`` (default
     5000 — an unbounded 65536-event dump built a multi-hundred-MB body on
     the event loop of a loaded server) and ``?since_us=`` (epoch µs)."""
@@ -1257,9 +1379,14 @@ async def handle_trace(request: web.Request) -> web.Response:
         if rec is None:
             return _err(404, f"trace {trace_id!r} is not in the flight "
                              "recorder (evicted or never retained)")
+        events = (state.events.query(trace_id=trace_id, limit=200)
+                  if state.events is not None else [])
         if request.query.get("format") == "record":
+            rec = dict(rec)
+            rec["events"] = events
             return web.json_response(rec)
-        return web.Response(text=spans_to_chrome(rec["spans"]),
+        return web.Response(text=spans_to_chrome(rec["spans"],
+                                                 events=events),
                             content_type="application/json")
     try:
         limit = int(request.query.get("limit", "5000"))
@@ -1282,6 +1409,46 @@ async def handle_slow(request: web.Request) -> web.Response:
     state: ServerState = request.app[STATE_KEY]
     return web.json_response(state.recorder.dump(
         model=request.query.get("model")))
+
+
+async def handle_events(request: web.Request) -> web.Response:
+    """GET /debug/events?since_us=&level=&subsystem=&trace_id=&limit= —
+    the structured event ring (docs/OBSERVABILITY.md "The third pillar"),
+    oldest-first within the newest ``limit`` matches. Junk query params
+    400 (the /debug/trace hardening discipline)."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.events is None:
+        return _err(409, "[events] is disabled; no events are recorded")
+    try:
+        q = events_mod.parse_events_query(request.query)
+    except ValueError as e:
+        return _err(400, str(e))
+    return web.json_response({"events": state.events.query(**q),
+                              **state.events.stats()})
+
+
+async def handle_postmortems(request: web.Request) -> web.Response:
+    """GET /debug/postmortems — the crash-forensics ledger: one record per
+    reaped process death (exit code/signal, stderr tail, last black-box
+    snapshot), newest first. Populated by the supervisors behind the
+    router tier; a leaf worker answers its (empty) own ledger so the
+    endpoint shape is uniform across tiers."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.postmortems is None:
+        return _err(409, "[events] is disabled; no postmortems are kept")
+    return web.json_response({"postmortems": state.postmortems.dump(),
+                              **state.postmortems.stats()})
+
+
+async def handle_audit(request: web.Request) -> web.Response:
+    """GET /debug/audit — the admin audit trail: every :reload /
+    :rollback / :warm / /debug/profile / drain with outcome, duration, and
+    verb-specific fields, newest first."""
+    state: ServerState = request.app[STATE_KEY]
+    if state.audit is None:
+        return _err(409, "[events] is disabled; no audit trail is kept")
+    return web.json_response({"audit": state.audit.dump(),
+                              **state.audit.stats()})
 
 
 _INDEX_HTML = """<!doctype html><title>tpuserve</title>
@@ -1320,18 +1487,31 @@ async def handle_reload(request: web.Request) -> web.Response:
     lc = state.lifecycles.get(name)
     if lc is None:
         return _err(409, "weight reload is not supported in recycle mode")
+    t0 = time.perf_counter()
+
+    def _audit(outcome: str, **fields) -> None:
+        if state.audit is not None:
+            state.audit.record(
+                "reload", name, outcome,
+                duration_ms=(time.perf_counter() - t0) * 1e3, **fields)
+
     try:
         info = await lc.reload()
     except ReloadRejected as e:
         body = {"error": str(e), "stage": e.stage,
                 "rolled_back": e.rolled_back,
                 "version": state.runtimes[name].version}
+        _audit("rolled_back" if e.rolled_back else "rejected",
+               stage=e.stage, version=state.runtimes[name].version,
+               error=str(e))
         # Pre-publish rejection = client/artifact conflict (409); a
         # post-publish rollback means the server briefly published bad
         # weights and recovered (500 so operators page on it).
         return web.json_response(body, status=500 if e.rolled_back else 409)
     except Exception as e:  # noqa: BLE001
+        _audit("error", error=str(e))
         return _err(500, f"reload failed: {e}")
+    _audit("ok", version=info.get("version"))
     return web.json_response(info)
 
 
@@ -1345,10 +1525,21 @@ async def handle_rollback(request: web.Request) -> web.Response:
     lc = state.lifecycles.get(name)
     if lc is None:
         return _err(409, "versioned lifecycle is not supported in recycle mode")
+    t0 = time.perf_counter()
     try:
         info = await lc.rollback(reason="manual")
     except ValueError as e:
+        if state.audit is not None:
+            state.audit.record(
+                "rollback", name, "rejected",
+                duration_ms=(time.perf_counter() - t0) * 1e3, error=str(e))
         return _err(409, str(e))
+    if state.audit is not None:
+        state.audit.record(
+            "rollback", name, "ok",
+            duration_ms=(time.perf_counter() - t0) * 1e3,
+            version=info.get("version"),
+            rolled_back_from=info.get("rolled_back_from"))
     return web.json_response(info)
 
 
@@ -1378,12 +1569,23 @@ async def handle_warm(request: web.Request) -> web.Response:
     if state.scheduler is None:
         return _err(409, "the fleet scheduler ([scheduler] enabled) owns "
                          "warm/cold states; it is not enabled")
+    t0 = time.perf_counter()
+
+    def _audit(outcome: str, **fields) -> None:
+        if state.audit is not None:
+            state.audit.record(
+                "warm", name, outcome,
+                duration_ms=(time.perf_counter() - t0) * 1e3, **fields)
+
     try:
         info = await state.scheduler.warm(name)
     except ValueError as e:
+        _audit("rejected", error=str(e))
         return _err(409, str(e))
     except Exception as e:  # noqa: BLE001 — a failed warm keeps it cold
+        _audit("error", error=str(e))
         return _err(500, f"warm-up failed (model stays cold): {e}")
+    _audit("ok", state=info.get("state"))
     return web.json_response(info)
 
 
@@ -1479,6 +1681,11 @@ def make_app(state: ServerState, loop_index: int = 0,
     app.router.add_post("/debug/profile", _main_loop_handler(handle_profile))
     app.router.add_get("/debug/trace", handle_trace)
     app.router.add_get("/debug/slow", handle_slow)
+    # Event plane (ISSUE 15): all three read locked structures — safe from
+    # any accept loop, like /debug/slow.
+    app.router.add_get("/debug/events", handle_events)
+    app.router.add_get("/debug/postmortems", handle_postmortems)
+    app.router.add_get("/debug/audit", handle_audit)
     app.router.add_get("/", handle_index)
 
     if primary:

@@ -12,16 +12,25 @@ owns (tpuserve.obs.Metrics):
 - ``fleet``   — exposition parse/merge for the router's fleet scrape
   (``GET /metrics/fleet`` / ``/stats/fleet``);
 - ``profile`` — on-demand jax.profiler device-trace capture merged with
-  the span ring (``POST /debug/profile``).
+  the span ring (``POST /debug/profile``);
+- ``events``  — the structured event plane, crash-forensics black box,
+  and admin audit trail (``GET /debug/events`` / ``/debug/postmortems`` /
+  ``/debug/audit``; docs/OBSERVABILITY.md "The third pillar").
 """
 
+from tpuserve.telemetry.events import (AuditLog, BlackBoxWriter, EventLog,
+                                       PostmortemLog)
 from tpuserve.telemetry.fleet import merge_expositions, parse_exposition
 from tpuserve.telemetry.profile import ProfileCapture
 from tpuserve.telemetry.slo import SloEngine, UtilizationDeriver
 from tpuserve.telemetry.store import MetricSampler, TimeSeriesStore
 
 __all__ = [
+    "AuditLog",
+    "BlackBoxWriter",
+    "EventLog",
     "MetricSampler",
+    "PostmortemLog",
     "ProfileCapture",
     "SloEngine",
     "TimeSeriesStore",

@@ -59,6 +59,22 @@ async def _validator(url: str, payload: bytes, ctype: str, ref: bytes,
             await asyncio.sleep(0.01)
 
 
+async def _await_postmortem(state, deadline_s: float = 10.0) -> list[dict]:
+    """Wait for the supervisor's (executor-thread) postmortem capture to
+    land, then return the ledger. The drills gate on this evidence
+    (ISSUE 15): an injected SIGKILL that leaves no postmortem naming the
+    signal is a forensics regression, not a flaky race."""
+    if state.postmortems is None:
+        return []
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        records = state.postmortems.dump()
+        if any(r.get("signal") == "SIGKILL" for r in records):
+            return records
+        await asyncio.sleep(0.1)
+    return state.postmortems.dump()
+
+
 async def _worker_compile_totals(urls: dict[int, str]) -> dict[int, float]:
     """Sum runtime_compiles_total across models per worker, straight off
     each worker's own /metrics (the drill shares the router's process, so
@@ -197,6 +213,7 @@ async def run_host_kill_drill(cfg: ServerConfig, model_name: str | None = None,
         stop_validator.set()
         await validator_task
         compiles_after = await _worker_compile_totals(survivor_urls)
+        postmortems = await _await_postmortem(state)
         workers = state.supervisor.stats()
     finally:
         await runner.cleanup()  # on_cleanup -> state.stop() -> fleet drain
@@ -205,6 +222,7 @@ async def run_host_kill_drill(cfg: ServerConfig, model_name: str | None = None,
     total = result.n_ok + result.n_err
     out["availability"] = round(result.n_ok / total, 5) if total else 0.0
     out["drill"] = "host_kill"
+    out["postmortems"] = postmortems
     out["kill"] = kill_info
     out["integrity"] = integrity
     out["workers"] = workers
@@ -306,6 +324,7 @@ async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None
         await kill_task
         stop_validator.set()
         await validator_task
+        postmortems = await _await_postmortem(state)
         workers = state.supervisor.stats()
     finally:
         await runner.cleanup()  # on_cleanup -> state.stop() -> fleet drain
@@ -314,6 +333,7 @@ async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None
     total = result.n_ok + result.n_err
     out["availability"] = round(result.n_ok / total, 5) if total else 0.0
     out["drill"] = "worker_kill"
+    out["postmortems"] = postmortems
     out["kill"] = kill_info
     out["integrity"] = integrity
     out["workers"] = workers

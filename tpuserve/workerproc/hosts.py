@@ -47,6 +47,9 @@ import time
 
 from tpuserve.config import ServerConfig
 from tpuserve.obs import Metrics
+from tpuserve.telemetry.events import (read_snapshot, read_tail,
+                                       redirect_stderr,
+                                       resolve_blackbox_dir)
 from tpuserve.workerproc.supervisor import spawn_worker_blocking
 from tpuserve.workerproc.worker import worker_config
 
@@ -91,6 +94,12 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
     events follow, and EOF coming down means the router died — drain and
     exit rather than serve as an orphan fleet.
     """
+    # Black box (ISSUE 15): the agent's own stderr goes to its per-host
+    # capture file — an agent dying with its whole domain must leave its
+    # last words where the router's postmortem reader can find them.
+    redirect_stderr(opts.get("stderr_path"),
+                    f"{host_name(host_id)} boot pid {os.getpid()} "
+                    f"ts {time.time():.3f}")
     # Own session = own process group = one addressable failure domain:
     # killpg(pgid, SIGKILL) takes agent + workers down in one syscall,
     # exactly like the machine losing power.
@@ -162,9 +171,17 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
                             opts["respawn_initial_s"]
                             * opts["respawn_multiplier"] ** slot.fails)
                 slot.next_at = now + delay
+                # The agent folds the black box into the worker_down
+                # message itself (ISSUE 15): on a real multi-machine
+                # deployment the capture files live on THIS box, so the
+                # evidence must cross the control pipe, not a filesystem.
+                ecfg = slot.cfg.events
                 router_gone |= not _send(
                     {"op": "worker_down", "wid": slot.wid, "exitcode": code,
-                     "eta_s": delay})
+                     "eta_s": delay, "pid": slot.pid,
+                     "stderr_tail": read_tail(ecfg.stderr_path or None,
+                                              ecfg.stderr_tail_bytes),
+                     "snapshot": read_snapshot(ecfg.snapshot_path or None)})
             elif slot.proc is None and now >= slot.next_at:
                 try:
                     _spawn(slot)
@@ -271,10 +288,12 @@ class HostSupervisor:
     live_workers / track_inflight / respawn_eta_s / sweep / stats), one
     level of failure domain up."""
 
-    def __init__(self, cfg: ServerConfig, metrics: Metrics) -> None:
+    def __init__(self, cfg: ServerConfig, metrics: Metrics,
+                 postmortems=None) -> None:
         self.cfg = cfg
         self.rcfg = cfg.router
         self.metrics = metrics
+        self.postmortems = postmortems
         self.n_hosts = cfg.router.hosts
         self.per_host = cfg.router.workers
         self.n = self.n_hosts * self.per_host
@@ -348,6 +367,12 @@ class HostSupervisor:
             "respawn_multiplier": self.rcfg.respawn_multiplier,
             "drain_timeout_s": self.cfg.drain_timeout_s,
         }
+        if self.cfg.events.enabled:
+            # Agent stderr capture (ISSUE 15): per-host file beside the
+            # workers' — a killpg'd domain leaves the agent's last words.
+            opts["stderr_path"] = os.path.join(
+                resolve_blackbox_dir(self.cfg.events),
+                f"{host_name(hid)}.stderr")
         ctx = mp.get_context("spawn")
         parent, child = ctx.Pipe()
         proc = ctx.Process(
@@ -478,6 +503,17 @@ class HostSupervisor:
             ref.healthy = False
         self._g_worker_up[wid].set(0.0)
         self._g_worker_inflight[wid].set(0.0)
+        if self.postmortems is not None:
+            # The agent already folded the black box into the pipe message
+            # (tail + snapshot read on ITS machine) — pure bookkeeping
+            # here, safe on the loop.
+            self.postmortems.add(
+                "worker", f"worker{wid}",
+                msg.get("pid", ref.pid if ref is not None else None),
+                msg.get("exitcode"),
+                stderr_tail=msg.get("stderr_tail"),
+                snapshot=msg.get("snapshot"),
+                worker=wid, host=h.hid, respawn_eta_s=msg.get("eta_s"))
 
     def _on_worker_up(self, h: HostHandle, wid: int, port: int,
                       pid: int) -> None:
@@ -513,6 +549,7 @@ class HostSupervisor:
             os.killpg(h.pgid, signal.SIGKILL)  # no orphan half-domain
         except (OSError, ProcessLookupError):
             pass
+        self._schedule_host_postmortem(hid, h)
         self.host_deaths_total += 1
         for ref in h.workers.values():
             if ref.up:
@@ -525,6 +562,40 @@ class HostSupervisor:
         self.hosts[hid] = None
         self._g_host_up[hid].set(0.0)
         self._schedule_respawn(hid)
+
+    def _schedule_host_postmortem(self, hid: int, h: HostHandle) -> None:
+        """Fold a dead DOMAIN into one postmortem record: the agent's exit
+        code/signal + stderr tail, plus every lost worker's last black-box
+        snapshot (an agent killed wholesale cannot report them over the
+        pipe, so the router reads the slot files itself). File IO on an
+        executor thread."""
+        if self.postmortems is None:
+            return
+        exitcode = h.proc.exitcode
+        agent_pid = h.pid
+        worker_rows = [(r.wid, r.pid,
+                        self._worker_cfgs[r.wid].events.snapshot_path)
+                       for r in h.workers.values()]
+        stderr_path = (os.path.join(resolve_blackbox_dir(self.cfg.events),
+                                    f"{host_name(hid)}.stderr")
+                       if self.cfg.events.enabled else None)
+        loop = asyncio.get_running_loop()
+
+        def _collect() -> None:
+            workers = [{"worker": wid, "pid": pid,
+                        "snapshot": read_snapshot(snap or None)}
+                       for wid, pid, snap in worker_rows]
+            self.postmortems.capture_blocking(
+                "host", host_name(hid), agent_pid, exitcode,
+                stderr_path=stderr_path, host=hid, workers=workers,
+                workers_lost=len(worker_rows))
+
+        async def _capture() -> None:
+            await loop.run_in_executor(None, _collect)
+
+        t = loop.create_task(_capture())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
 
     def _schedule_respawn(self, hid: int) -> None:
         if self._stopping or hid in self._respawning:

@@ -286,12 +286,20 @@ class TopologyClient:
 # ---------------------------------------------------------------------------
 
 def peer_main(cfg: ServerConfig, router_id: int, public_host: str,
-              public_port: int, primary_peer_url: str, conn) -> None:
+              public_port: int, primary_peer_url: str, conn,
+              stderr_path: str = "") -> None:
     """Peer-router process entry (multiprocessing spawn target). Device-free
     like every router: it builds no models, owns no workers — it binds the
     shared public port with SO_REUSEPORT, owns its cache shard, and relays
-    to the worker addresses it syncs from the primary."""
+    to the worker addresses it syncs from the primary. ``stderr_path``
+    (ISSUE 15) captures this process's stderr for the primary's postmortem
+    reader."""
     from tpuserve.server import configure_logging
+    from tpuserve.telemetry.events import redirect_stderr
+
+    redirect_stderr(stderr_path,
+                    f"router {router_id} boot pid {os.getpid()} "
+                    f"ts {time.time():.3f}")
 
     configure_logging(cfg)
     log.info("peer router %d: starting (pid %d)", router_id, os.getpid())
@@ -417,11 +425,12 @@ class PeerRouterSupervisor:
     change so the primary rebuilds its hash ring."""
 
     def __init__(self, cfg: ServerConfig, metrics: Metrics,
-                 on_change) -> None:
+                 on_change, postmortems=None) -> None:
         self.cfg = cfg
         self.rcfg = cfg.router
         self.metrics = metrics
         self.on_change = on_change
+        self.postmortems = postmortems
         self.rids = list(range(1, cfg.router.routers))
         self.peers: dict[int, PeerHandle] = {}
         self._fails = {rid: 0 for rid in self.rids}
@@ -452,13 +461,24 @@ class PeerRouterSupervisor:
         log.info("peer routers up: %s",
                  [f"{h.rid}@{h.peer_port}" for h in spawned])
 
+    def _peer_stderr_path(self, rid: int) -> str:
+        """The peer router's stderr capture file (ISSUE 15); "" when the
+        event plane is off."""
+        if not self.cfg.events.enabled:
+            return ""
+        from tpuserve.telemetry.events import resolve_blackbox_dir
+
+        return os.path.join(resolve_blackbox_dir(self.cfg.events),
+                            f"router{rid}.stderr")
+
     def _spawn_blocking(self, rid: int) -> PeerHandle:
         ctx = mp.get_context("spawn")
         parent, child = ctx.Pipe()
         host, port = self._public
         proc = ctx.Process(
             target=peer_main,
-            args=(self.cfg, rid, host, port, self._primary_peer_url, child),
+            args=(self.cfg, rid, host, port, self._primary_peer_url, child,
+                  self._peer_stderr_path(rid)),
             daemon=True, name=f"tpuserve-router-{rid}")
         proc.start()
         child.close()
@@ -503,12 +523,34 @@ class PeerRouterSupervisor:
                 log.error("peer router %d (pid %d) died (code %s)",
                           rid, h.pid, h.proc.exitcode)
                 self.deaths_total += 1
+                self._schedule_postmortem(rid, h)
                 h.close()
                 del self.peers[rid]
                 self._g_up[rid].set(0.0)
                 self.on_change()
                 self._schedule_respawn(rid)
         return died
+
+    def _schedule_postmortem(self, rid: int, h: PeerHandle) -> None:
+        """A dead peer router gets the same forensics as a dead worker:
+        exit code/signal + its stderr-capture tail (ISSUE 15). Peers write
+        no black-box snapshots — they own no models, so the stderr tail
+        and the primary's event ring are the evidence."""
+        if self.postmortems is None:
+            return
+        exitcode = h.proc.exitcode
+        stderr_path = self._peer_stderr_path(rid) or None
+        loop = asyncio.get_running_loop()
+
+        async def _capture() -> None:
+            await loop.run_in_executor(
+                None, lambda: self.postmortems.capture_blocking(
+                    "router", f"router{rid}", h.pid, exitcode,
+                    stderr_path=stderr_path, router=rid))
+
+        t = loop.create_task(_capture())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
 
     def _schedule_respawn(self, rid: int) -> None:
         if self._stopping or rid in self._respawning:

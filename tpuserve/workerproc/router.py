@@ -64,8 +64,10 @@ from tpuserve.faults import CircuitBreaker, Watchdog
 from tpuserve.obs import (FlightRecorder, Metrics, TraceContext,
                           exposition_content_type, spans_to_chrome)
 from tpuserve.server import _err, _requested_timeout_ms, configure_logging
-from tpuserve.telemetry import (MetricSampler, SloEngine, TimeSeriesStore,
+from tpuserve.telemetry import (AuditLog, EventLog, MetricSampler,
+                                PostmortemLog, SloEngine, TimeSeriesStore,
                                 merge_expositions, parse_exposition)
+from tpuserve.telemetry import events as events_mod
 from tpuserve.workerproc.hosts import HostSupervisor, host_name
 from tpuserve.workerproc.peers import (
     HashRing,
@@ -182,15 +184,35 @@ class RouterState:
             error_capacity=cfg.trace.error_capacity,
             always_record_errors=cfg.trace.always_record_errors,
             metrics=self.metrics)
+        # Structured event plane + black box + audit trail (ISSUE 15,
+        # docs/OBSERVABILITY.md "The third pillar"). The router's
+        # postmortem ledger is THE fleet-wide one: its supervisors reap
+        # every worker, host agent, and peer router.
+        self.events: EventLog | None = None
+        self.audit: AuditLog | None = None
+        self.postmortems: PostmortemLog | None = None
+        if cfg.events.enabled:
+            ecfg = cfg.events
+            self.events = EventLog(self.metrics, ecfg.capacity,
+                                   jsonl_path=ecfg.jsonl_path)
+            self.audit = AuditLog(self.metrics, ecfg.audit_capacity,
+                                  events=self.events)
+            self.postmortems = PostmortemLog(
+                self.metrics, ecfg.postmortem_capacity,
+                tail_bytes=ecfg.stderr_tail_bytes, events=self.events)
+            events_mod.install_bridge(self.events, ecfg.bridge_level)
+            events_mod.set_active(self.events)
         if not self.is_primary:
             # Peer router: a passive worker view synced from the primary.
             self.supervisor = PassiveWorkerView(cfg, self.metrics)
         elif cfg.router.hosts > 0:
             # Host failure domains (ISSUE 13): workers grouped under host
             # agents, each agent one SIGKILL-able process group.
-            self.supervisor = HostSupervisor(cfg, self.metrics)
+            self.supervisor = HostSupervisor(cfg, self.metrics,
+                                             postmortems=self.postmortems)
         else:
-            self.supervisor = WorkerSupervisor(cfg, self.metrics)
+            self.supervisor = WorkerSupervisor(cfg, self.metrics,
+                                               postmortems=self.postmortems)
         self.watchdog = Watchdog(cfg.watchdog_interval_s, self.metrics)
         # Horizontal router tier (ISSUE 13): the consistent-hash ring over
         # every live router's peer listener. None until membership is known
@@ -203,7 +225,8 @@ class RouterState:
         # SO_REUSEPORT socket BEFORE start() so peer routers can join it.
         self.public_addr: tuple[str, int] | None = None
         self.peer_sup = (PeerRouterSupervisor(cfg, self.metrics,
-                                              self._rebuild_ring)
+                                              self._rebuild_ring,
+                                              postmortems=self.postmortems)
                          if self.is_primary and cfg.router.routers > 1
                          else None)
         self.topo = (TopologyClient(self, primary_peer_url,
@@ -370,6 +393,7 @@ class RouterState:
         the single-process fix — the watchdog must not respawn a worker
         this drain is about to SIGTERM), stop admitting, then wait for
         every in-flight relay to resolve within the budget."""
+        t0 = time.perf_counter()
         await self.watchdog.stop()
         if self.sampler is not None:
             await asyncio.get_running_loop().run_in_executor(
@@ -378,7 +402,14 @@ class RouterState:
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         while self._inflight > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.02)
-        return self._inflight == 0
+        drained = self._inflight == 0
+        if self.audit is not None:
+            self.audit.record(
+                "drain", "server", "ok" if drained else "budget_expired",
+                duration_ms=(time.perf_counter() - t0) * 1e3,
+                router_id=self.router_id,
+                drain_timeout_s=self.cfg.drain_timeout_s)
+        return drained
 
     async def stop(self) -> None:
         await self.watchdog.stop()
@@ -401,6 +432,8 @@ class RouterState:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        if self.events is not None:
+            self.events.close()  # flush/close the JSONL sink fd
 
     # -- shed hints ----------------------------------------------------------
     def no_worker_retry_after(self) -> int:
@@ -641,12 +674,54 @@ class RouterState:
         except Exception as e:  # noqa: BLE001 — worker died mid-admin
             return w.wid, 0, {"error": f"{type(e).__name__}: {e}"}
 
+    def _audit_fanout(self, verb: str, name: str, status: int, body: dict,
+                      t0: float) -> None:
+        """Fold one admin fan-out into the audit trail (ISSUE 15): verb,
+        outcome, duration, the post-action cache generation, and the
+        per-host (or per-worker) outcome map — the operator-facing answer
+        to "what did that reload actually touch"."""
+        if self.audit is None:
+            return
+        outcome = ("ok" if status == 200
+                   else "rejected" if status in (409, 503)
+                   else "error")
+        fields: dict = {"status": status,
+                        "generation": self.generations.get(name)}
+        if "version" in body:
+            fields["version"] = body["version"]
+        if body.get("down"):
+            fields["down"] = body["down"]
+        per_host = body.get("per_host")
+        if per_host is not None:
+            # Per-domain rollup, not the full per-worker bodies: the audit
+            # record must stay small enough to keep 256 of.
+            fields["per_host"] = {
+                host: {wid: row.get("status") for wid, row in rows.items()}
+                for host, rows in per_host.items()}
+        elif body.get("workers"):
+            fields["per_worker"] = {
+                str(wid): row.get("status")
+                for wid, row in body["workers"].items()}
+        if body.get("rolled_back_workers"):
+            fields["rolled_back_workers"] = list(
+                body["rolled_back_workers"])
+        self.audit.record(verb, name, outcome,
+                          duration_ms=(time.perf_counter() - t0) * 1e3,
+                          **fields)
+
     async def fanout_reload(self, name: str) -> tuple[int, dict]:
         """Atomic fleet reload: POST ``:reload`` to every live worker; if
         any worker fails its gates, roll the succeeded ones back so the
         fleet never serves mixed versions. On success the router cache
         generation bumps, atomically invalidating every older cached
-        answer (the cross-process analog of PR 5's version binding)."""
+        answer (the cross-process analog of PR 5's version binding).
+        Every outcome — refusal included — lands in the audit trail."""
+        t0 = time.perf_counter()
+        status, body = await self._fanout_reload(name)
+        self._audit_fanout("reload", name, status, body, t0)
+        return status, body
+
+    async def _fanout_reload(self, name: str) -> tuple[int, dict]:
         workers = self.live_workers()
         if not workers:
             return 503, {"error": "no live worker to reload",
@@ -739,7 +814,15 @@ class RouterState:
 
     async def fanout_simple(self, name: str, op: str) -> tuple[int, dict]:
         """Best-effort fan-out for ``:rollback`` (every live worker must
-        restore the same retained version) and ``/versions``."""
+        restore the same retained version) and ``/versions``. Rollbacks
+        are audited; version reads are not (reads mutate nothing)."""
+        t0 = time.perf_counter()
+        status, body = await self._fanout_simple(name, op)
+        if op == "rollback":
+            self._audit_fanout("rollback", name, status, body, t0)
+        return status, body
+
+    async def _fanout_simple(self, name: str, op: str) -> tuple[int, dict]:
         workers = self.live_workers()
         if not workers:
             return 503, {"error": "no live worker", "workers": {}}
@@ -904,7 +987,21 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
                   status=resp.status)
     if "X-Trace-Id" not in resp.headers:
         resp.headers["X-Trace-Id"] = ctx.trace_id
-    state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    kinds = state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    if state.events is not None:
+        # Trace-correlated flight data (ISSUE 15): the single-process
+        # discipline at the front door — errored/shed and retained-slow
+        # requests leave an event the stitched /debug/trace interleaves.
+        if resp.status >= 400:
+            state.events.emit(
+                "error" if resp.status >= 500 else "warning", "router",
+                "request_error", model=name, trace_id=ctx.trace_id,
+                status=resp.status, duration_ms=round(dur_s * 1e3, 3))
+        elif "slow" in kinds:
+            state.events.emit(
+                "info", "router", "slow_request", model=name,
+                trace_id=ctx.trace_id, status=resp.status,
+                duration_ms=round(dur_s * 1e3, 3))
     return resp
 
 
@@ -1278,6 +1375,14 @@ async def handle_stats(request: web.Request) -> web.Response:
         "workers_per_domain": state.rcfg.workers,
     }
     out["trace"] = state.recorder.stats()
+    # Event plane (ISSUE 15): ring/audit/postmortem occupancy — the
+    # records live at /debug/events, /debug/audit, /debug/postmortems.
+    if state.events is not None:
+        out["events"] = {
+            **state.events.stats(),
+            "audit": state.audit.stats(),
+            "postmortems": state.postmortems.stats(),
+        }
     # Telemetry plane (ISSUE 14): sampler heartbeat + the router-tier SLO
     # view (burn over client-observed latency). History at /stats/history,
     # the fleet merge at /metrics/fleet + /stats/fleet.
@@ -1312,14 +1417,20 @@ async def handle_trace(request: web.Request) -> web.Response:
     with every live worker's record for the same trace id (their spans
     carry pid = worker id + 1), rendered as one Chrome trace — the
     router→worker hop reads as a gap between the attempt span on lane 0
-    and the worker's request span on its lane. ``&format=record`` returns
-    the merged raw spans instead (what a higher tier would stitch)."""
+    and the worker's request span on its lane. Matching structured events
+    from the router's ring AND every worker's (each worker's record
+    carries its own, ISSUE 15) interleave as instant events, so the one
+    artifact shows the spans and what each process was saying.
+    ``&format=record`` returns the merged raw spans + events instead
+    (what a higher tier would stitch)."""
     state: RouterState = request.app[ROUTER_KEY]
     trace_id = request.query.get("trace_id")
     if not trace_id:
         return _err(400, "the router trace endpoint needs ?trace_id=... "
                          "(find recorded ids at /debug/slow)")
     spans: list[dict] = []
+    events: list[dict] = (state.events.query(trace_id=trace_id, limit=200)
+                          if state.events is not None else [])
     meta: dict = {"trace_id": trace_id, "sources": []}
     rec = state.recorder.get(trace_id)
     if rec is not None:
@@ -1337,15 +1448,92 @@ async def handle_trace(request: web.Request) -> web.Response:
         for wid, status, body in results:
             if status == 200 and isinstance(body.get("spans"), list):
                 spans.extend(body["spans"])
+                if isinstance(body.get("events"), list):
+                    events.extend(body["events"])
                 meta["sources"].append(f"worker{wid}")
     if not spans:
         return _err(404, f"trace {trace_id!r} is not recorded on the "
                          "router or any live worker")
     if request.query.get("format") == "record":
         meta["spans"] = spans
+        meta["events"] = events
         return web.json_response(meta)
-    return web.Response(text=spans_to_chrome(spans),
+    return web.Response(text=spans_to_chrome(spans, events=events),
                         content_type="application/json")
+
+
+async def handle_events(request: web.Request) -> web.Response:
+    """GET /debug/events — the ROUTER's structured event ring (supervision
+    events, relay errors, audit mirror). Worker rings are one hop away at
+    /workers/{wid}/debug/events. Same query surface + junk-param 400s as
+    the worker endpoint."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if state.events is None:
+        return _err(409, "[events] is disabled; no events are recorded")
+    try:
+        q = events_mod.parse_events_query(request.query)
+    except ValueError as e:
+        return _err(400, str(e))
+    return web.json_response({"events": state.events.query(**q),
+                              **state.events.stats()})
+
+
+async def handle_postmortems(request: web.Request) -> web.Response:
+    """GET /debug/postmortems — the fleet-wide crash-forensics ledger: one
+    record per reaped worker / host agent / peer router, each carrying
+    exit code + killing signal, the dead process's stderr tail, and its
+    last black-box snapshot (docs/OBSERVABILITY.md "The third pillar").
+    The primary's supervisors reap everything, so the primary's ledger is
+    authoritative; peers proxy to it."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if state.postmortems is None:
+        return _err(409, "[events] is disabled; no postmortems are kept")
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(state, "GET",
+                                             "/peer/debug/postmortems")
+    return web.json_response({"postmortems": state.postmortems.dump(),
+                              **state.postmortems.stats()})
+
+
+async def handle_audit(request: web.Request) -> web.Response:
+    """GET /debug/audit — the fleet admin audit trail. Admin verbs are
+    serialized through the PRIMARY (the PR-13 reload contract), so the
+    primary's trail is the fleet's; peers proxy to it."""
+    state: RouterState = request.app[ROUTER_KEY]
+    if state.audit is None:
+        return _err(409, "[events] is disabled; no audit trail is kept")
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(state, "GET",
+                                             "/peer/debug/audit")
+    return web.json_response({"audit": state.audit.dump(),
+                              **state.audit.stats()})
+
+
+async def handle_worker_events(request: web.Request) -> web.Response:
+    """GET /workers/{wid}/debug/events — operator passthrough to one
+    worker's event ring (workers bind loopback), query included."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        wid = int(request.match_info["wid"])
+    except ValueError:
+        return _err(400, "worker id must be an integer")
+    if not 0 <= wid < state.supervisor.n:
+        return _err(404, f"no worker slot {wid}")
+    w = state.supervisor.worker_by_id(wid)
+    if w is None:
+        return _err(503, f"worker {wid} is down (respawning)")
+    try:
+        async with state._session.get(
+                f"{w.base_url}/debug/events",
+                params=dict(request.query),
+                timeout=aiohttp.ClientTimeout(total=10.0)) as r:
+            raw = await r.read()
+            return web.Response(body=raw, status=r.status,
+                                content_type=r.content_type or "text/plain")
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:  # noqa: BLE001
+        return _err(503, f"worker {wid} unreachable: {e}")
 
 
 async def handle_models(request: web.Request) -> web.Response:
@@ -1563,6 +1751,12 @@ def make_peer_app(state: RouterState) -> web.Application:
     app.router.add_get("/peer/metrics", handle_metrics)
     app.router.add_get("/peer/fleet/metrics", handle_fleet_metrics)
     app.router.add_get("/peer/fleet/stats", handle_fleet_stats)
+    # Event plane (ISSUE 15): peers proxy their public audit/postmortem
+    # endpoints to these on the primary (the primary's ledgers are the
+    # fleet's — admin verbs serialize through it, its supervisors reap
+    # every process).
+    app.router.add_get("/peer/debug/audit", handle_audit)
+    app.router.add_get("/peer/debug/postmortems", handle_postmortems)
     return app
 
 
@@ -1589,6 +1783,7 @@ def make_router_app(state: RouterState,
     app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
     app.router.add_get("/admin/models/{name}/versions", handle_versions)
     app.router.add_get("/workers/{wid}/stats/history", handle_worker_history)
+    app.router.add_get("/workers/{wid}/debug/events", handle_worker_events)
     app.router.add_get("/workers/{wid}/{page}", handle_worker_proxy)
     app.router.add_get("/healthz", handle_healthz)
     app.router.add_get("/metrics", handle_metrics)
@@ -1601,6 +1796,11 @@ def make_router_app(state: RouterState,
     app.router.add_get("/alerts", handle_router_alerts)
     app.router.add_get("/debug/slow", handle_slow)
     app.router.add_get("/debug/trace", handle_trace)
+    # Event plane (ISSUE 15): the router's ring, the fleet postmortem
+    # ledger, and the primary-serialized audit trail.
+    app.router.add_get("/debug/events", handle_events)
+    app.router.add_get("/debug/postmortems", handle_postmortems)
+    app.router.add_get("/debug/audit", handle_audit)
     app.router.add_get("/", handle_index)
 
     if own_lifecycle:

@@ -110,12 +110,20 @@ def spawn_worker_blocking(wcfg, wid: int, spawn_timeout_s: float):
 
 
 class WorkerSupervisor:
-    """Owns the worker fleet for one router process."""
+    """Owns the worker fleet for one router process.
 
-    def __init__(self, cfg: ServerConfig, metrics: Metrics) -> None:
+    ``postmortems`` (ISSUE 15): when the router's event plane is on, every
+    reaped worker death is folded into a forensics record — exit
+    code/signal, the slot's stderr-capture tail, and its last black-box
+    snapshot — on an executor thread (the file reads must not block the
+    loop the sweep runs on)."""
+
+    def __init__(self, cfg: ServerConfig, metrics: Metrics,
+                 postmortems=None) -> None:
         self.cfg = cfg
         self.rcfg = cfg.router
         self.metrics = metrics
+        self.postmortems = postmortems
         self.n = cfg.router.workers
         # Derived once so every respawn serves an identical config (and so
         # recycle-mode rejection fires at construction, not mid-respawn).
@@ -251,11 +259,36 @@ class WorkerSupervisor:
     def _on_dead(self, wid: int, h: WorkerHandle, why: str) -> None:
         log.error("worker %d (pid %d) died: %s", wid, h.pid, why)
         self.deaths_total += 1
+        self._schedule_postmortem(wid, h)
         h.close()
         self.slots[wid] = None
         self._g_up[wid].set(0.0)
         self._g_inflight[wid].set(0.0)
         self._schedule_respawn(wid)
+
+    def _schedule_postmortem(self, wid: int, h: WorkerHandle) -> None:
+        """Fold the dead worker's black box into a postmortem record on an
+        executor thread (sweep/_on_dead run on the event loop and must not
+        read files there). The capture races the eventual respawn's boot
+        banner by the whole backoff window, so the tail it reads is the
+        dead incarnation's."""
+        if self.postmortems is None:
+            return
+        ecfg = self._worker_cfgs[wid].events
+        exitcode = h.proc.exitcode
+        loop = asyncio.get_running_loop()
+
+        async def _capture() -> None:
+            await loop.run_in_executor(
+                None, lambda: self.postmortems.capture_blocking(
+                    "worker", f"worker{wid}", h.pid, exitcode,
+                    stderr_path=ecfg.stderr_path or None,
+                    snapshot_path=ecfg.snapshot_path or None,
+                    worker=wid))
+
+        t = loop.create_task(_capture())
+        self._bg.add(t)
+        t.add_done_callback(self._bg.discard)
 
     def _schedule_respawn(self, wid: int) -> None:
         if self._stopping or wid in self._respawning:

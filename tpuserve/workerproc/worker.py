@@ -40,8 +40,10 @@ from __future__ import annotations
 
 import copy
 import os
+import time
 
 from tpuserve.config import ServerConfig
+from tpuserve.telemetry.events import redirect_stderr, resolve_blackbox_dir
 
 
 def worker_config(cfg: ServerConfig, worker_id: int) -> ServerConfig:
@@ -62,6 +64,19 @@ def worker_config(cfg: ServerConfig, worker_id: int) -> ServerConfig:
     # Router-owned layers never run in the worker.
     wcfg.router.enabled = False
     wcfg.cache.enabled = False
+    # Black box (ISSUE 15, docs/OBSERVABILITY.md "The third pillar"): the
+    # supervisor resolves ONE black-box directory for the deployment
+    # (stable across respawns — it runs in the supervisor's process) and
+    # assigns the slot's stderr capture + postmortem-snapshot files. The
+    # worker redirects its own fd 2 at spawn and checkpoints snapshots;
+    # the supervisor reads both back at reap time.
+    if cfg.events.enabled and not wcfg.events.stderr_path:
+        bb = resolve_blackbox_dir(cfg.events)
+        wcfg.events.dir = bb
+        wcfg.events.stderr_path = os.path.join(
+            bb, f"worker{worker_id}.stderr")
+        wcfg.events.snapshot_path = os.path.join(
+            bb, f"worker{worker_id}.snapshot.json")
     return wcfg
 
 
@@ -73,6 +88,14 @@ def worker_main(cfg: ServerConfig, worker_id: int, conn) -> None:
     ``conn`` carries the ready handshake; it stays open afterward purely so
     an EOF can tell this worker the supervisor vanished.
     """
+    # Black box step 1 (ISSUE 15): redirect fd 2 to the slot's capture
+    # file BEFORE any import can write to it — a native crash's abort
+    # message, an OOM killer's aftermath, a Python traceback: all of it
+    # lands in a file the supervisor folds into the postmortem instead of
+    # interleaving onto the supervisor's tty and dying with the process.
+    redirect_stderr(cfg.events.stderr_path,
+                    f"worker {worker_id} boot pid {os.getpid()} "
+                    f"ts {time.time():.3f}")
     # Spawned children re-run sitecustomize, which may re-force a hardware
     # platform via jax.config; re-assert the env's platform choice before
     # any backend init (mirrors tpuserve.deferred._worker_run).
@@ -92,6 +115,11 @@ def worker_main(cfg: ServerConfig, worker_id: int, conn) -> None:
     try:
         state = ServerState(cfg)
         state.worker_id = worker_id
+        if state.events is not None:
+            # Events carry the same process-lane vocabulary as spans
+            # (0 = router, worker id + 1 behind it) so a stitched trace's
+            # interleaved events land on the right lane.
+            state.events.pid = worker_id + 1
         state.build()
     except Exception as e:  # noqa: BLE001 — report any boot death upward
         try:
