@@ -1,0 +1,180 @@
+#!/usr/bin/env bash
+# Hostile-tenant autopilot drill (ISSUE 16): a REAL router fleet (2 host
+# domains x 2 workers, one slot per host dormant as scale-up headroom)
+# with the self-healing controller engaged and per-tenant containment on.
+# Unattended, two things go wrong at once:
+#   - tenant "hostile" floods far past its device-seconds quota and
+#     request rate (and ignores Retry-After);
+#   - a seeded [faults] worker_slow rule arms itself MID-load (after_s),
+#     pinned to one boot-active worker — a single-host latency fault.
+# Gates (docs/OPERATIONS.md "Self-operating fleet"):
+#   1. containment: the hostile overage is 429'd at admission with
+#      tenant_* shed reasons, while the victim tenant's availability
+#      holds >= 97% through flood + fault, no operator in the loop;
+#   2. reaction: the controller acts (scale_up under pressure and/or
+#      shed-on-burn) within the run, first action inside the load window;
+#   3. audit: every controller decision — rollbacks included — is
+#      readable from GET /debug/audit as an autopilot:* verb, fetched
+#      over HTTP from the live fleet.
+# A second leg runs the pure-policy + tenant-ledger suites.
+# Runs the real `python -m tpuserve chaos --drill autopilot` CLI; wired
+# into chaos_smoke.sh and CI next to the worker/host/fleet drills.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): router, host agents,
+# the controller tick, and all workers run under witnessed locks.
+export TPUSERVE_LOCK_WITNESS=1
+
+CFG="$(mktemp /tmp/tpuserve_autopilot_drill.XXXXXX.toml)"
+OUT="$(mktemp /tmp/tpuserve_autopilot_drill.XXXXXX.json)"
+BB="$(mktemp -d /tmp/tpuserve_autopilot_drill_bb.XXXXXX)"
+trap 'rm -f "$CFG" "$OUT"; rm -rf "$BB"' EXIT
+
+cat > "$CFG" <<EOF
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+watchdog_interval_s = 0.2
+
+[telemetry]
+sample_interval_s = 0.25
+burn_windows_s = [5.0, 30.0, 120.0]
+
+[events]
+dir = "$BB"
+snapshot_interval_s = 0.3
+
+[router]
+enabled = true
+hosts = 2
+workers = 2
+active_workers = 1
+retry_max = 3
+hedge_ms = 500.0
+health_interval_s = 0.2
+respawn_initial_s = 0.5
+respawn_max_s = 5.0
+
+[autopilot]
+enabled = true
+interval_s = 0.25
+hysteresis_ticks = 2
+cooldown_s = 3.0
+window_s = 30.0
+max_actions_per_window = 8
+follow_up_s = 5.0
+pressure_high = 1.5
+pressure_low = 0.05
+
+[tenants]
+enabled = true
+window_s = 30.0
+slo_latency_ms = 2000.0
+slo_availability = 0.99
+
+[[tenants.tenant]]
+name = "hostile"
+api_key = "drill-hostile-key"
+weight = 1.0
+quota_device_s = 3.0
+rate_per_s = 40.0
+
+[[tenants.tenant]]
+name = "victim"
+api_key = "drill-victim-key"
+weight = 4.0
+
+[faults]
+enabled = true
+seed = 7
+
+# Single-host latency fault, armed mid-load: worker 2 is host 1's
+# boot-active slot (wid = host * workers + i with active_workers = 1).
+[[faults.rule]]
+kind = "worker_slow"
+model = "*"
+probability = 1.0
+delay_ms = 250.0
+after_s = 6.0
+worker = 2
+
+[[model]]
+name = "toy"
+family = "toy"
+batch_buckets = [1, 2]
+deadline_ms = 2.0
+dtype = "float32"
+num_classes = 10
+parallelism = "single"
+request_timeout_ms = 10000.0
+wire_size = 8
+EOF
+
+python -m tpuserve chaos --config "$CFG" --drill autopilot \
+    --duration 18 --warmup 1 --concurrency 12 \
+    --min-availability 0.97 | tee "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, sys
+
+s = json.load(open(sys.argv[1]))
+hostile, victim = s["tenants"]["hostile"], s["tenants"]["victim"]
+ap, audit = s["autopilot"], s["audit"]
+
+# 1. Containment: the flood was 429'd with tenant_* reasons, and the
+#    overage cost the hostile tenant, never the victim.
+assert hostile["n_429"] > 0, f"hostile flood never shed: {hostile}"
+t_reasons = {r: n for r, n in hostile["reasons"].items()
+             if r.startswith("tenant_")}
+assert t_reasons, f"no tenant_* shed reason on hostile 429s: {hostile}"
+assert s["availability"] >= 0.97, \
+    f"victim availability {s['availability']} under flood + fault"
+assert victim["n_429"] == 0, \
+    f"victim was rate/quota-shed — containment leaked: {victim}"
+
+# 2. Reaction: the controller acted unattended, within the load window,
+#    and its scale/shed verbs are the ones that matter here.
+assert ap["actions_total"] >= 1, f"controller never acted: {ap}"
+acted = set(ap["action_kinds"])
+assert acted & {"scale_up", "shed_on"}, \
+    f"no scale_up/shed_on under pressure+burn: {ap['action_kinds']}"
+assert ap["first_action_s"] is not None and ap["first_action_s"] <= 18.0, \
+    f"first controller action outside the load window: {ap['first_action_s']}"
+assert ap["errors_total"] == 0, f"controller actuation errors: {ap}"
+assert ap["http_status"] == 200, \
+    f"GET /debug/autopilot returned {ap['http_status']}"
+
+# 3. Audit: every decision (rollbacks included) readable from the live
+#    /debug/audit endpoint as an autopilot:* verb.
+assert audit["complete"], f"decisions missing from /debug/audit: {audit}"
+assert audit["autopilot_records"] >= ap["actions_total"] or \
+    audit["autopilot_records"] >= audit["decisions_total"], audit
+# Decisions carry their triggering signal values into the trail.
+assert all(d.get("signals") for d in ap["decisions"]), \
+    "a controller decision recorded no triggering signals"
+
+# Per-tenant SLO burn stayed green for the victim (never 'firing').
+v_slo = s.get("tenant_slo", {}).get("victim", {})
+assert v_slo.get("state", "ok") != "firing", \
+    f"victim SLO burned during the drill: {v_slo}"
+
+# The ledger charged the hostile tenant real device-seconds.
+usage = s["usage"]["tenants"]
+assert usage["hostile"]["device_seconds_total"] > 0, usage
+assert s["tenants_endpoint_status"] == 200, \
+    f"GET /tenants returned {s['tenants_endpoint_status']}"
+
+print(f"autopilot drill OK: victim availability {s['availability']}, "
+      f"hostile shed {hostile['n_429']}x ({t_reasons}), "
+      f"controller {ap['actions_total']} actions {dict(ap['action_kinds'])} "
+      f"first at {ap['first_action_s']}s, "
+      f"{audit['autopilot_records']} audit records "
+      f"(rollbacks {ap['rollbacks_total']})")
+EOF
+
+echo "== pure-policy + tenant-ledger suites =="
+python -m pytest tests/test_autopilot.py tests/test_tenants.py -q \
+    -p no:cacheprovider
+
+echo "autopilot drill OK"

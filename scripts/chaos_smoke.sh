@@ -59,4 +59,7 @@ scripts/host_drill.sh
 echo "== fleet drill (poison one model @ 100%, survivors hold >= 99%) =="
 scripts/fleet_drill.sh
 
+echo "== autopilot drill (hostile tenant + mid-load latency fault, controller sheds/scales/contains unattended) =="
+scripts/autopilot_drill.sh
+
 echo "chaos smoke OK"

@@ -13,7 +13,7 @@ OUTDIR="${TPUSERVE_CI_DUMP_DIR:-/tmp/tpuserve-ci-dumps}/${LABEL}-$$"
 mkdir -p "$OUTDIR" || exit 0
 echo "debug_dump: pulling flight data from $BASE into $OUTDIR" >&2
 for page in "debug/events" "debug/postmortems" "debug/slow" \
-            "debug/audit" "stats"; do
+            "debug/audit" "alerts" "stats" "stats/history"; do
   fname="${page//\//_}.json"
   curl -fsS --max-time 10 "$BASE/$page" -o "$OUTDIR/$fname" 2>/dev/null \
     || echo "unreachable: $BASE/$page" > "$OUTDIR/$fname.unreachable"

@@ -1,0 +1,374 @@
+"""Pure-policy tests for the self-healing fleet controller (ISSUE 16).
+
+AutopilotPolicy is a pure function of (Signals, its own bounded memory):
+all time comes from ``Signals.now``, so every damping behavior —
+hysteresis, per-knob cooldowns, the windowed action budget, and
+rollback-on-worse — is table-driven here by constructing signal
+sequences. No live server, no sleeps, no clocks.
+"""
+
+import asyncio
+
+import pytest
+
+from tpuserve.config import AutopilotConfig
+from tpuserve.scheduler.autopilot import (INVERSE, Action, AutopilotLoop,
+                                          AutopilotPolicy, DomainSignal,
+                                          ModelSignal, Signals, objective)
+
+
+def ap_cfg(**over) -> AutopilotConfig:
+    base = dict(enabled=True, interval_s=0.25, hysteresis_ticks=2,
+                cooldown_s=5.0, max_actions_per_window=8, window_s=60.0,
+                follow_up_s=10.0, rollback_tolerance=0.5,
+                pressure_high=2.0, pressure_low=0.25, min_slots=1)
+    base.update(over)
+    return AutopilotConfig(**base)
+
+
+def dom(hid=0, pressure=0.0, active=1, max_slots=2, healthy=1, up=True):
+    return DomainSignal(hid=hid, up=up, active=active, max_slots=max_slots,
+                        healthy=healthy, pressure=pressure)
+
+
+def mod(name="m", burn_state="ok", shed_engaged=False, warm=True,
+        wants_warm=False, idle=False):
+    return ModelSignal(name=name, burn_state=burn_state,
+                       shed_engaged=shed_engaged, warm=warm,
+                       wants_warm=wants_warm, idle=idle)
+
+
+def sig(now, domains=(), models=(), clear=0.0):
+    return Signals(now=now, domains=list(domains), models=list(models),
+                   predicted_clear_s=clear)
+
+
+def kinds(actions: list[Action]) -> list[str]:
+    return [a.kind for a in actions]
+
+
+# -- objective ----------------------------------------------------------------
+
+@pytest.mark.parametrize("models,domains,expect", [
+    ([], [], 0.0),
+    ([mod(burn_state="ok")], [dom(pressure=0.5)], 0.5),
+    ([mod(burn_state="pending")], [dom(pressure=0.0)], 10.0),
+    ([mod(burn_state="firing")], [dom(pressure=1.0)], 21.0),
+    # Down domains are excluded from the pressure mean.
+    ([], [dom(hid=0, pressure=2.0), dom(hid=1, pressure=0.0, up=False)], 2.0),
+    # Worst model dominates; mean over live domains breaks ties.
+    ([mod("a", "ok"), mod("b", "firing")],
+     [dom(hid=0, pressure=1.0), dom(hid=1, pressure=3.0)], 22.0),
+])
+def test_objective_scalar(models, domains, expect):
+    assert objective(sig(0.0, domains, models)) == pytest.approx(expect)
+
+
+# -- hysteresis ---------------------------------------------------------------
+
+@pytest.mark.parametrize("pressures,expect_tick", [
+    # hysteresis_ticks=3: the third consecutive hot tick acts.
+    ([5.0, 5.0, 5.0], 2),
+    # One cool sample resets the streak — acts 3 ticks after the gap.
+    ([5.0, 5.0, 0.5, 5.0, 5.0, 5.0], 5),
+    ([5.0, 0.5, 5.0, 0.5, 5.0, 0.5], None),  # never 3 in a row
+])
+def test_hysteresis_consecutive_ticks(pressures, expect_tick):
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=3))
+    fired_at = None
+    for i, pr in enumerate(pressures):
+        acts = p.decide(sig(float(i), [dom(pressure=pr)]))
+        if acts and fired_at is None:
+            fired_at = i
+            assert kinds(acts) == ["scale_up"]
+    assert fired_at == expect_tick
+
+
+def test_acting_consumes_the_streak():
+    # After an action the SAME trigger must re-accumulate a full streak
+    # (cooldown=0 isolates the streak behavior).
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=2, cooldown_s=0.0,
+                               follow_up_s=0.0))
+    hot = [dom(pressure=5.0, active=1, max_slots=4)]
+    assert p.decide(sig(0.0, hot)) == []
+    assert kinds(p.decide(sig(1.0, hot))) == ["scale_up"]
+    assert p.decide(sig(2.0, hot)) == []  # streak consumed, re-arming
+    assert kinds(p.decide(sig(3.0, hot))) == ["scale_up"]
+
+
+# -- cooldown -----------------------------------------------------------------
+
+def test_cooldown_locks_the_knob_then_releases():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=10.0,
+                               follow_up_s=0.0))
+    hot = [dom(pressure=5.0, active=1, max_slots=4)]
+    assert kinds(p.decide(sig(0.0, hot))) == ["scale_up"]
+    # Trigger still held: inside cooldown nothing moves.
+    for t in (1.0, 5.0, 9.9):
+        assert p.decide(sig(t, hot)) == []
+    assert kinds(p.decide(sig(10.0, hot))) == ["scale_up"]
+
+
+def test_cooldown_is_per_target():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=10.0,
+                               follow_up_s=0.0))
+    assert kinds(p.decide(sig(0.0, [dom(hid=0, pressure=5.0)]))) \
+        == ["scale_up"]
+    # A different host's knob is untouched by host 0's cooldown.
+    acts = p.decide(sig(1.0, [dom(hid=0, pressure=5.0),
+                              dom(hid=1, pressure=5.0)]))
+    assert [(a.kind, a.target) for a in acts] == [("scale_up", "host:1")]
+
+
+# -- action budget ------------------------------------------------------------
+
+def test_budget_caps_actions_per_window_and_reopens():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=0.0,
+                               follow_up_s=0.0, max_actions_per_window=2,
+                               window_s=60.0))
+    hosts = [dom(hid=h, pressure=5.0) for h in range(4)]
+    acts = p.decide(sig(0.0, hosts))
+    assert len(acts) == 2  # 4 triggers held, budget admits 2
+    assert p.budget_deferrals_total == 2
+    assert p.decide(sig(1.0, hosts)) == []  # window still full
+    # The window slides: 61s later the budget is open again.
+    assert len(p.decide(sig(61.0, hosts))) == 2
+
+
+# -- rollback -----------------------------------------------------------------
+
+def test_rollback_on_worse_objective():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=0.0,
+                               follow_up_s=10.0, rollback_tolerance=0.5))
+    assert kinds(p.decide(sig(0.0, [dom(pressure=5.0)]))) == ["scale_up"]
+    # Follow-up due at t=10; the objective got WORSE (pressure 5 -> 9).
+    acts = p.decide(sig(10.0, [dom(pressure=9.0, active=2)]))
+    rb = [a for a in acts if a.rollback_of]
+    assert len(rb) == 1
+    assert rb[0].kind == "scale_down" and rb[0].rollback_of == "scale_up"
+    assert rb[0].reason == "rollback"
+    assert rb[0].signals["objective_before"] == pytest.approx(5.0)
+    assert rb[0].signals["objective_now"] == pytest.approx(9.0)
+    assert p.rollbacks_total == 1
+
+
+@pytest.mark.parametrize("pressure_later", [5.0, 4.0, 5.4])
+def test_no_rollback_when_objective_held_or_improved(pressure_later):
+    # Within tolerance (0.5) or improved: the action stands.
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=0.0,
+                               follow_up_s=10.0, rollback_tolerance=0.5))
+    p.decide(sig(0.0, [dom(pressure=5.0)]))
+    acts = p.decide(sig(10.0, [dom(pressure=pressure_later, active=2)]))
+    assert not [a for a in acts if a.rollback_of]
+    assert p.rollbacks_total == 0
+
+
+def test_rollback_bypasses_budget():
+    # Budget exhausted by the original action; the undo must not queue.
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=0.0,
+                               follow_up_s=10.0, max_actions_per_window=1,
+                               window_s=60.0))
+    assert kinds(p.decide(sig(0.0, [dom(pressure=5.0)]))) == ["scale_up"]
+    acts = p.decide(sig(10.0, [dom(pressure=9.0, active=2)]))
+    assert "scale_down" in kinds(acts)
+
+
+def test_rollback_cools_both_kinds_no_flap():
+    # After an undo, the original trigger (still held) must NOT re-fire
+    # the same pair next tick: both knobs of the pair cool.
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=30.0,
+                               follow_up_s=10.0))
+    p.decide(sig(0.0, [dom(pressure=5.0, active=1, max_slots=4)]))
+    # t=30: scale_up's own cooldown has lapsed, so only the rollback's
+    # freshly-set cooldown holds the pair down afterwards (the domain
+    # keeps headroom, so cooldown is the only thing stopping a re-fire).
+    hot = [dom(pressure=9.0, active=2, max_slots=4)]
+    acts = p.decide(sig(30.0, hot))
+    assert kinds(acts) == ["scale_down"]
+    for t in (31.0, 40.0, 59.9):
+        assert p.decide(sig(t, hot)) == [], f"flap at t={t}"
+    assert kinds(p.decide(sig(60.0, hot))) == ["scale_up"]
+
+
+# -- shed-on-burn -------------------------------------------------------------
+
+@pytest.mark.parametrize("burn,engaged,expect", [
+    ("firing", False, ["shed_on"]),
+    ("firing", True, []),   # already engaged
+    ("pending", False, []),  # pending never sheds
+    ("ok", True, ["shed_off"]),
+    ("ok", False, []),
+    ("pending", True, []),   # not ok yet: shed stays on
+])
+def test_shed_decision_table(burn, engaged, expect):
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, follow_up_s=0.0))
+    acts = p.decide(sig(0.0, models=[mod("m", burn, engaged)]))
+    assert kinds(acts) == expect
+    if expect:
+        assert acts[0].target == "m"
+        assert acts[0].signals["burn_state"] == burn
+
+
+def test_burn_shed_disabled_by_config():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, burn_shed=False))
+    assert p.decide(sig(0.0, models=[mod("m", "firing")])) == []
+
+
+# -- scale --------------------------------------------------------------------
+
+@pytest.mark.parametrize("d,models,clear,expect", [
+    # Hot with headroom -> up; hot at ceiling -> nothing.
+    (dom(pressure=5.0, active=1, max_slots=2), [], 0.0, ["scale_up"]),
+    (dom(pressure=5.0, active=2, max_slots=2), [], 0.0, []),
+    # Cold above the floor -> down; cold at the floor -> nothing.
+    (dom(pressure=0.0, active=2, max_slots=2), [], 0.0, ["scale_down"]),
+    (dom(pressure=0.0, active=1, max_slots=2), [], 0.0, []),
+    # Cold but a model is burning: never scale down into a burn.
+    (dom(pressure=0.0, active=2, max_slots=2),
+     [mod("m", "pending")], 0.0, []),
+    # In the hysteresis band between low and high: hold.
+    (dom(pressure=1.0, active=1, max_slots=2), [], 0.0, []),
+    # Down domains are never scaled.
+    (dom(pressure=5.0, active=1, max_slots=2, up=False), [], 0.0, []),
+])
+def test_scale_decision_table(d, models, clear, expect):
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, follow_up_s=0.0))
+    acts = p.decide(sig(0.0, [d], models, clear=clear))
+    assert kinds(acts) == expect
+    if expect:
+        assert acts[0].target == f"host:{d.hid}"
+
+
+def test_clear_time_trigger():
+    cfg = ap_cfg(hysteresis_ticks=1, follow_up_s=0.0, clear_high_s=5.0)
+    p = AutopilotPolicy(cfg)
+    # Pressure is calm but the predicted clear time is hot: scale up, and
+    # the same signal vetoes any scale-down.
+    acts = p.decide(sig(0.0, [dom(pressure=0.0, active=1, max_slots=2)],
+                        clear=9.0))
+    assert kinds(acts) == ["scale_up"]
+    assert acts[0].signals["predicted_clear_s"] == pytest.approx(9.0)
+    p2 = AutopilotPolicy(cfg)
+    assert p2.decide(sig(0.0, [dom(pressure=0.0, active=2, max_slots=2)],
+                         clear=9.0)) == []
+
+
+def test_scale_disabled_by_config():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, scale=False))
+    assert p.decide(sig(0.0, [dom(pressure=9.0)])) == []
+
+
+# -- paging -------------------------------------------------------------------
+
+@pytest.mark.parametrize("m,max_warm,expect", [
+    (mod("m", warm=False, wants_warm=True), 0, ["warm"]),
+    (mod("m", warm=True, wants_warm=True), 0, []),      # already warm
+    (mod("m", warm=True, idle=True), 0, ["demote"]),
+    (mod("m", warm=True, idle=True, wants_warm=True), 0, []),  # demand wins
+    (mod("m", warm=False, wants_warm=False), 0, []),
+])
+def test_paging_decision_table(m, max_warm, expect):
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, follow_up_s=0.0,
+                               paging=True, max_warm=max_warm))
+    assert kinds(p.decide(sig(0.0, models=[m]))) == expect
+
+
+def test_paging_warm_budget():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, follow_up_s=0.0,
+                               paging=True, max_warm=1))
+    # One model already warm: a cold model wanting warmth is refused by
+    # the cross-model budget (no action — the trigger never holds).
+    acts = p.decide(sig(0.0, models=[
+        mod("a", warm=True), mod("b", warm=False, wants_warm=True)]))
+    assert kinds(acts) == []
+
+
+def test_paging_off_by_default():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1))
+    assert p.decide(
+        sig(0.0, models=[mod("m", warm=False, wants_warm=True)])) == []
+
+
+# -- inverse map / describe ---------------------------------------------------
+
+def test_inverse_map_is_an_involution():
+    for kind, inv in INVERSE.items():
+        assert INVERSE[inv] == kind
+
+
+def test_describe_counters():
+    p = AutopilotPolicy(ap_cfg(hysteresis_ticks=1, cooldown_s=0.0,
+                               follow_up_s=10.0, max_actions_per_window=1))
+    p.decide(sig(0.0, [dom(hid=0, pressure=5.0), dom(hid=1, pressure=5.0)]))
+    d = p.describe()
+    assert d["actions_in_window"] == 1
+    assert d["budget_deferrals_total"] == 1
+    assert d["watches_open"] == 1
+    assert d["rollbacks_total"] == 0
+
+
+# -- the loop (no server: injected signal/actuate fns) ------------------------
+
+def test_loop_tick_actuates_and_records():
+    async def run():
+        cfg = ap_cfg(hysteresis_ticks=1, cooldown_s=0.0, follow_up_s=0.0)
+        ticks = iter([
+            sig(0.0, [dom(pressure=5.0)]),
+            sig(1.0, [dom(hid=1, pressure=5.0)]),
+        ])
+        acted: list[tuple[str, str]] = []
+
+        async def actuate(a: Action) -> str:
+            acted.append((a.kind, a.target))
+            return "ok" if a.target == "host:0" else "error: host down"
+
+        loop = AutopilotLoop(cfg, lambda: next(ticks), actuate)
+        await loop.tick()
+        await loop.tick()
+        assert acted == [("scale_up", "host:0"), ("scale_up", "host:1")]
+        assert loop.ticks == 2
+        assert loop.actions_total == 2 and loop.errors_total == 1
+        d = loop.describe()
+        assert [r["outcome"] for r in d["decisions"]] \
+            == ["ok", "error: host down"]
+        assert d["decisions"][0]["signals"]["pressure"] == pytest.approx(5.0)
+
+    asyncio.run(run())
+
+
+def test_loop_actuator_exception_is_an_error_outcome():
+    async def run():
+        cfg = ap_cfg(hysteresis_ticks=1, follow_up_s=0.0)
+
+        async def actuate(a: Action) -> str:
+            raise RuntimeError("boom")
+
+        loop = AutopilotLoop(cfg, lambda: sig(0.0, [dom(pressure=5.0)]),
+                             actuate)
+        await loop.tick()
+        assert loop.errors_total == 1
+        rec = loop.describe()["decisions"][0]
+        assert rec["outcome"].startswith("error: RuntimeError")
+
+    asyncio.run(run())
+
+
+def test_loop_decision_history_is_bounded():
+    async def run():
+        cfg = ap_cfg(hysteresis_ticks=1, cooldown_s=0.0, follow_up_s=0.0,
+                     max_actions_per_window=1000, history=4)
+        t = [0.0]
+
+        def signals():
+            t[0] += 1.0
+            return sig(t[0], [dom(hid=int(t[0]) % 997, pressure=5.0)])
+
+        async def actuate(a: Action) -> str:
+            return "ok"
+
+        loop = AutopilotLoop(cfg, signals, actuate)
+        for _ in range(10):
+            await loop.tick()
+        assert len(loop.describe()["decisions"]) == 4
+
+    asyncio.run(run())

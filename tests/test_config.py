@@ -455,3 +455,178 @@ family = "resnet50"
     assert cfg2.events.enabled is False
     assert cfg2.events.capacity == 4096
     assert cfg2.events.stderr_path == "" and cfg2.events.snapshot_path == ""
+
+
+def test_tenants_block(tmp_path):
+    p = tmp_path / "tenants.toml"
+    p.write_text(
+        """
+[tenants]
+enabled = true
+window_s = 30.0
+allow_anonymous = "public"
+share_slack = 1.5
+slo_latency_ms = 250.0
+slo_availability = 0.995
+slo_burn_alert = 6.0
+
+[[tenants.tenant]]
+name = "acme"
+api_key = "acme-key"
+weight = 3.0
+quota_device_s = 10.0
+rate_per_s = 20.0
+burst = 40.0
+
+[[tenants.tenant]]
+name = "tiny"
+api_key = "tiny-key"
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    t = cfg.tenants
+    assert t.enabled is True
+    assert t.window_s == 30.0
+    assert t.allow_anonymous == "public"
+    assert t.share_slack == 1.5
+    assert t.slo_latency_ms == 250.0
+    assert t.slo_availability == 0.995
+    assert t.slo_burn_alert == 6.0
+    assert [x.name for x in t.tenants] == ["acme", "tiny"]
+    acme = t.tenants[0]
+    assert acme.api_key == "acme-key"
+    assert acme.weight == 3.0
+    assert acme.quota_device_s == 10.0
+    assert acme.rate_per_s == 20.0
+    assert acme.burst == 40.0
+    # The second entry rides on defaults: weight 1, no envelope.
+    assert t.tenants[1].weight == 1.0
+    assert t.tenants[1].quota_device_s == 0.0
+    # Defaults + dot-path override.
+    cfg2 = load_config(None, overrides=["tenants.enabled=true"])
+    assert cfg2.tenants.enabled is True
+    assert cfg2.tenants.window_s == 60.0
+    assert cfg2.tenants.tenants == []
+
+
+def test_tenants_block_validation(tmp_path):
+    from tpuserve.config import TenantConfig, TenantsConfig
+
+    with pytest.raises(ValueError, match="window_s"):
+        TenantsConfig(window_s=0.0)
+    with pytest.raises(ValueError, match="share_slack"):
+        TenantsConfig(share_slack=-1.0)
+    with pytest.raises(ValueError, match="slo_latency_ms"):
+        TenantsConfig(slo_latency_ms=-1.0)
+    with pytest.raises(ValueError, match="slo_availability"):
+        TenantsConfig(slo_availability=1.0)
+    with pytest.raises(ValueError, match="slo_burn_alert"):
+        TenantsConfig(slo_burn_alert=0.0)
+    with pytest.raises(ValueError, match="name"):
+        TenantConfig(name="", api_key="k")
+    with pytest.raises(ValueError, match="api_key"):
+        TenantConfig(name="t", api_key="")
+    with pytest.raises(ValueError, match="weight"):
+        TenantConfig(name="t", api_key="k", weight=0.0)
+    with pytest.raises(ValueError, match="quota_device_s"):
+        TenantConfig(name="t", api_key="k", quota_device_s=-1.0)
+    # Duplicate names/keys are rejected when the TOML list is assembled.
+    p = tmp_path / "dup.toml"
+    p.write_text(
+        """
+[tenants]
+enabled = true
+
+[[tenants.tenant]]
+name = "a"
+api_key = "k1"
+
+[[tenants.tenant]]
+name = "a"
+api_key = "k2"
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    with pytest.raises(ValueError, match="unique"):
+        load_config(str(p))
+
+
+def test_autopilot_block(tmp_path):
+    p = tmp_path / "autopilot.toml"
+    p.write_text(
+        """
+[autopilot]
+enabled = true
+interval_s = 0.25
+hysteresis_ticks = 2
+cooldown_s = 3.0
+max_actions_per_window = 4
+window_s = 30.0
+follow_up_s = 5.0
+rollback_tolerance = 0.25
+pressure_high = 1.5
+pressure_low = 0.1
+clear_high_s = 8.0
+min_slots = 2
+burn_shed = false
+scale = true
+paging = true
+max_warm = 2
+history = 64
+
+[[model]]
+name = "rn"
+family = "resnet50"
+"""
+    )
+    cfg = load_config(str(p))
+    a = cfg.autopilot
+    assert a.enabled is True
+    assert a.interval_s == 0.25
+    assert a.hysteresis_ticks == 2
+    assert a.cooldown_s == 3.0
+    assert a.max_actions_per_window == 4
+    assert a.window_s == 30.0
+    assert a.follow_up_s == 5.0
+    assert a.rollback_tolerance == 0.25
+    assert a.pressure_high == 1.5
+    assert a.pressure_low == 0.1
+    assert a.clear_high_s == 8.0
+    assert a.min_slots == 2
+    assert a.burn_shed is False
+    assert a.scale is True
+    assert a.paging is True
+    assert a.max_warm == 2
+    assert a.history == 64
+    # Defaults + dot-path override.
+    cfg2 = load_config(None, overrides=["autopilot.enabled=true"])
+    assert cfg2.autopilot.enabled is True
+    assert cfg2.autopilot.interval_s == 0.5
+    assert cfg2.autopilot.hysteresis_ticks == 3
+    assert cfg2.autopilot.paging is False
+
+
+def test_autopilot_block_validation():
+    from tpuserve.config import AutopilotConfig
+
+    with pytest.raises(ValueError, match="interval_s"):
+        AutopilotConfig(interval_s=0.0)
+    with pytest.raises(ValueError, match="hysteresis_ticks"):
+        AutopilotConfig(hysteresis_ticks=0)
+    with pytest.raises(ValueError, match="max_actions_per_window"):
+        AutopilotConfig(max_actions_per_window=0)
+    with pytest.raises(ValueError, match="cooldown_s"):
+        AutopilotConfig(cooldown_s=-1.0)
+    with pytest.raises(ValueError, match="follow_up_s"):
+        AutopilotConfig(follow_up_s=-1.0)
+    with pytest.raises(ValueError, match="pressure_low"):
+        AutopilotConfig(pressure_low=2.0, pressure_high=1.0)
+    with pytest.raises(ValueError, match="min_slots"):
+        AutopilotConfig(min_slots=0)

@@ -1,0 +1,227 @@
+"""Tenant-containment tests (ISSUE 16): the TenantLedger's identity /
+rate / quota / fair-share admission, its usage view, and the result
+cache's tenant-weighted capacity partition. Pure in-process — no server.
+"""
+
+import pytest
+
+from tpuserve.cache import ModelCache
+from tpuserve.config import CacheConfig, TenantConfig, TenantsConfig
+from tpuserve.obs import Metrics
+from tpuserve.scheduler.tenants import TenantLedger
+
+
+def tenants_cfg(**over) -> TenantsConfig:
+    base = dict(
+        enabled=True,
+        window_s=60.0,
+        tenants=[
+            TenantConfig(name="alpha", api_key="key-alpha", weight=3.0),
+            TenantConfig(name="beta", api_key="key-beta", weight=1.0,
+                         quota_device_s=2.0, rate_per_s=2.0, burst=2.0),
+        ],
+    )
+    base.update(over)
+    return TenantsConfig(**base)
+
+
+def ledger(**over) -> TenantLedger:
+    return TenantLedger(tenants_cfg(**over), Metrics())
+
+
+# -- identity -----------------------------------------------------------------
+
+@pytest.mark.parametrize("key,expect", [
+    ("key-alpha", "alpha"),
+    ("key-beta", "beta"),
+    ("key-nope", None),
+    ("", None),
+    (None, None),
+])
+def test_resolve(key, expect):
+    assert ledger().resolve(key) == expect
+
+
+def test_resolve_anonymous_fallback():
+    led = ledger(allow_anonymous="anon")
+    assert led.resolve(None) == "anon"
+    assert led.resolve("key-nope") == "anon"
+    assert led.resolve("key-alpha") == "alpha"  # known keys still win
+    assert "anon" in led.names()
+    # The anonymous tenant rides with default weight and no envelope.
+    assert led.weight_of("anon") == 1.0
+    assert led.admit("anon") is None
+
+
+def test_shed_unknown_is_401():
+    shed = ledger().shed_unknown()
+    assert shed.status == 401 and shed.reason == "tenant_unknown"
+
+
+def test_names_weights():
+    led = ledger()
+    assert led.names() == ["alpha", "beta"]
+    assert led.weights() == {"alpha": 3.0, "beta": 1.0}
+    assert led.weight_of("alpha") == 3.0
+    assert led.weight_of("ghost") == 1.0  # harmless default
+
+
+# -- rate ---------------------------------------------------------------------
+
+def test_rate_token_bucket_exhausts():
+    led = ledger()
+    # burst=2: two admits drain the bucket, the third 429s with a hint.
+    assert led.admit("beta") is None
+    assert led.admit("beta") is None
+    shed = led.admit("beta")
+    assert shed is not None and shed.status == 429
+    assert shed.reason == "tenant_rate_exceeded"
+    assert shed.retry_after is not None and shed.retry_after >= 1
+
+
+def test_no_rate_limit_when_unset():
+    led = ledger()
+    for _ in range(100):
+        assert led.admit("alpha") is None  # alpha has no rate/quota
+
+
+# -- quota --------------------------------------------------------------------
+
+def test_quota_window_device_seconds():
+    led = ledger()
+    led.record("beta", 2.5)  # past the 2.0 device-second allowance
+    shed = led.admit("beta")
+    assert shed is not None and shed.status == 429
+    assert shed.reason == "tenant_quota_exceeded"
+    assert shed.retry_after is not None and 1 <= shed.retry_after <= 30
+    # The neighbor is untouched — containment, not collective punishment.
+    assert led.admit("alpha") is None
+
+
+def test_quota_under_allowance_admits():
+    led = ledger()
+    led.record("beta", 1.0)
+    assert led.admit("beta") is None
+
+
+def test_record_clamps_negative_charge():
+    led = ledger()
+    led.record("beta", -5.0)
+    assert led.usage()["tenants"]["beta"]["window_device_s"] == 0.0
+
+
+# -- fair share ---------------------------------------------------------------
+
+def test_share_shed_only_under_saturation():
+    led = ledger()
+    # beta (weight 1 of 4) hogs the whole observed window.
+    led.record("beta", 1.5)
+    led.record("alpha", 0.01)
+    assert led.admit("beta") is None  # not saturated: quota/rate only
+    led.saturated_fn = lambda: True
+    shed = led.admit("beta")
+    assert shed is not None and shed.reason == "tenant_share_exceeded"
+    # The heavyweight neighbor is within its share and keeps flowing.
+    assert led.admit("alpha") is None
+
+
+def test_share_shed_disabled_by_zero_slack():
+    led = ledger(share_slack=0.0)
+    led.saturated_fn = lambda: True
+    led.record("beta", 1.5)
+    assert led.admit("beta") is None
+
+
+# -- usage view ---------------------------------------------------------------
+
+def test_usage_shape_and_counts():
+    led = ledger()
+    assert led.admit("alpha") is None
+    led.record("alpha", 0.25)
+    u = led.usage()
+    assert u["enabled"] is True and u["window_s"] == 60.0
+    row = u["tenants"]["alpha"]
+    assert row["weight"] == 3.0
+    assert row["admitted_total"] == 1
+    assert row["window_device_s"] == pytest.approx(0.25)
+    assert row["device_seconds_total"] == pytest.approx(0.25)
+    # Refusals never count as admissions.
+    led.record("beta", 99.0)
+    assert led.admit("beta") is not None
+    assert led.usage()["tenants"]["beta"]["admitted_total"] == 0
+
+
+# -- config validation --------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(name=""),
+    dict(api_key=""),
+    dict(weight=0.0),
+    dict(weight=-1.0),
+    dict(quota_device_s=-1.0),
+    dict(rate_per_s=-1.0),
+])
+def test_tenant_config_rejects(kw):
+    base = dict(name="t", api_key="k")
+    base.update(kw)
+    with pytest.raises(ValueError):
+        TenantConfig(**base)
+
+
+def test_tenants_config_rejects_duplicates():
+    with pytest.raises(ValueError):
+        TenantsConfig(tenants=[
+            TenantConfig(name="a", api_key="k1"),
+            TenantConfig(name="a", api_key="k2")])
+    with pytest.raises(ValueError):
+        TenantsConfig(tenants=[
+            TenantConfig(name="a", api_key="k"),
+            TenantConfig(name="b", api_key="k")])
+
+
+# -- cache partition ----------------------------------------------------------
+
+def cache(capacity=8) -> ModelCache:
+    return ModelCache("toy", CacheConfig(enabled=True, capacity=capacity),
+                      Metrics(), lambda: 1)
+
+
+def test_cache_shares_follow_weights():
+    c = cache(capacity=8)
+    c.set_tenant_weights({"alpha": 3.0, "beta": 1.0})
+    stats = c.stats()
+    assert stats["tenants"]["alpha"]["share"] == 6
+    assert stats["tenants"]["beta"]["share"] == 2
+
+
+def test_cache_tenant_churn_evicts_own_entries_only():
+    c = cache(capacity=8)
+    c.set_tenant_weights({"alpha": 3.0, "beta": 1.0})
+    for i in range(3):
+        c.put(f"a{i}", {"v": i}, tenant="alpha")
+    # beta churns far past its 2-entry share...
+    for i in range(10):
+        c.put(f"b{i}", {"v": i}, tenant="beta")
+    stats = c.stats()["tenants"]
+    assert stats["beta"]["entries"] == 2  # capped at its share
+    # ...and every alpha entry survived the neighbor's churn.
+    assert stats["alpha"]["entries"] == 3
+    for i in range(3):
+        assert c.get(f"a{i}") is not None
+    # beta keeps its own NEWEST entries.
+    assert c.get("b9") is not None and c.get("b0") is None
+
+
+def test_cache_min_share_is_one():
+    c = cache(capacity=4)
+    c.set_tenant_weights({"whale": 1000.0, "minnow": 1.0})
+    assert c.stats()["tenants"]["minnow"]["share"] == 1
+
+
+def test_cache_unpartitioned_without_weights():
+    c = cache(capacity=2)
+    c.put("x", {"v": 1})
+    c.put("y", {"v": 2})
+    c.put("z", {"v": 3})
+    assert "tenants" not in c.stats()
+    assert c.get("x") is None  # plain LRU beyond capacity

@@ -88,6 +88,9 @@ class CacheEntry:
     value: Any
     body: bytes | None
     at: float  # time.monotonic() at population
+    # Tenant that populated the entry (ISSUE 16 partitioned capacity);
+    # None outside multi-tenant serving.
+    tenant: str | None = None
 
 
 @dataclass
@@ -102,6 +105,9 @@ class _Flight:
     # result was actually computed (the leader's trace has the batch
     # phases; the waiter's has only the coalesced link + the wait).
     leader_trace: "str | None" = None
+    # The leader's tenant: the completed flight populates into that
+    # tenant's cache partition.
+    tenant: "str | None" = None
 
 
 class ModelCache:
@@ -123,10 +129,39 @@ class ModelCache:
         self._c_evictions = c["evictions"]
         self._c_stale = c["stale_drops"]
         self._g_entries = metrics.gauge(f"cache_entries{{model={name}}}")
+        # Tenant partitioning (ISSUE 16): entry-count shares derived from
+        # tenant weights. Empty = unpartitioned (the single-tenant path).
+        self._tenant_shares: dict[str, int] = {}
+        self._tenant_counts: dict[str, int] = {}
+
+    def set_tenant_weights(self, weights: dict[str, float]) -> None:
+        """Partition capacity by tenant weight: each tenant's entries are
+        capped at ``max(1, floor(capacity * weight/total))`` so one
+        tenant's churn evicts its OWN oldest entries, never a neighbor's
+        hits. Hits stay content-addressed across tenants (identical bytes
+        are identical results — serving them is not a leak, the result
+        was computable from the request)."""
+        self._tenant_shares = {}
+        total = sum(weights.values())
+        if total <= 0:
+            return
+        for name, w in weights.items():
+            self._tenant_shares[name] = max(
+                1, int(self.cfg.capacity * w / total))
 
     # -- lookup ---------------------------------------------------------------
     def key_for(self, item: Any) -> str:
         return f"{self._version_fn()}:{item_digest(item)}"
+
+    def _pop(self, key: str) -> CacheEntry | None:
+        e = self._entries.pop(key, None)
+        if e is not None and e.tenant is not None:
+            n = self._tenant_counts.get(e.tenant, 0) - 1
+            if n > 0:
+                self._tenant_counts[e.tenant] = n
+            else:
+                self._tenant_counts.pop(e.tenant, None)
+        return e
 
     def get(self, key: str) -> CacheEntry | None:
         """Return the live entry for ``key`` (counting a hit) or None."""
@@ -134,7 +169,7 @@ class ModelCache:
         if e is None:
             return None
         if self.cfg.ttl_s > 0 and time.monotonic() - e.at > self.cfg.ttl_s:
-            del self._entries[key]
+            self._pop(key)
             self._g_entries.set(len(self._entries))
             return None
         # LRU touch: move to the end of the dict's insertion order.
@@ -143,7 +178,27 @@ class ModelCache:
         self._c_hits.inc()
         return e
 
-    def put(self, key: str, value: Any) -> None:
+    def _evict_one(self, tenant: str | None = None) -> None:
+        """Evict the oldest entry — of ``tenant`` when given, else of any
+        over-share tenant, else globally."""
+        victim = None
+        if tenant is not None:
+            victim = next((k for k, e in self._entries.items()
+                           if e.tenant == tenant), None)
+        else:
+            for k, e in self._entries.items():
+                share = (self._tenant_shares.get(e.tenant)
+                         if e.tenant is not None else None)
+                if share is not None \
+                        and self._tenant_counts.get(e.tenant, 0) > share:
+                    victim = k
+                    break
+        if victim is None:
+            victim = next(iter(self._entries))
+        self._pop(victim)
+        self._c_evictions.inc()
+
+    def put(self, key: str, value: Any, tenant: str | None = None) -> None:
         body = None
         if isinstance(value, (dict, list)):
             try:
@@ -152,17 +207,24 @@ class ModelCache:
                     body = raw
             except (TypeError, ValueError):
                 body = None  # non-JSON-able results cache by value only
-        self._entries.pop(key, None)
-        self._entries[key] = CacheEntry(value, body, time.monotonic())
+        self._pop(key)
+        self._entries[key] = CacheEntry(value, body, time.monotonic(), tenant)
+        if tenant is not None:
+            self._tenant_counts[tenant] = \
+                self._tenant_counts.get(tenant, 0) + 1
+            share = self._tenant_shares.get(tenant)
+            while share is not None \
+                    and self._tenant_counts.get(tenant, 0) > share:
+                self._evict_one(tenant)
         while len(self._entries) > self.cfg.capacity:
-            self._entries.pop(next(iter(self._entries)))
-            self._c_evictions.inc()
+            self._evict_one()
         self._g_entries.set(len(self._entries))
 
     # -- single-flight --------------------------------------------------------
     def submit_through(self, key: str,
                        submit: Callable[[], asyncio.Future],
-                       ctx: Any = None) -> asyncio.Future:
+                       ctx: Any = None,
+                       tenant: str | None = None) -> asyncio.Future:
         """Miss path: join the in-flight computation for ``key`` or lead a
         new one by calling ``submit()`` (which may raise, e.g. QueueFull —
         propagated to the caller with nothing registered).
@@ -188,7 +250,8 @@ class ModelCache:
         base = submit()
         self._c_misses.inc()
         fl = _Flight(key=key, version=self._version_fn(), waiters=[],
-                     leader_trace=ctx.trace_id if ctx is not None else None)
+                     leader_trace=ctx.trace_id if ctx is not None else None,
+                     tenant=tenant)
         if self.cfg.coalesce:
             self._flights[key] = fl
         w = loop.create_future()
@@ -213,7 +276,7 @@ class ModelCache:
             return
         val = base.result()
         if self._version_fn() == fl.version:
-            self.put(fl.key, val)
+            self.put(fl.key, val, tenant=fl.tenant)
         else:
             # Publish/rollback mid-flight: the result was admitted under a
             # version that is no longer live. Waiters still get it (same as
@@ -227,7 +290,7 @@ class ModelCache:
     # -- introspection --------------------------------------------------------
     def stats(self) -> dict:
         """The /stats "cache" block entry for this model."""
-        return {
+        out = {
             "entries": len(self._entries),
             "capacity": self.cfg.capacity,
             "inflight": len(self._flights),
@@ -237,9 +300,16 @@ class ModelCache:
             "evictions": self._c_evictions.value,
             "stale_drops": self._c_stale.value,
         }
+        if self._tenant_shares:
+            out["tenants"] = {
+                t: {"entries": self._tenant_counts.get(t, 0),
+                    "share": share}
+                for t, share in sorted(self._tenant_shares.items())}
+        return out
 
     def clear(self) -> None:
         self._entries.clear()
+        self._tenant_counts.clear()
         self._g_entries.set(0)
 
 

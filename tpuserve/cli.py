@@ -149,7 +149,7 @@ def main(argv: list[str] | None = None) -> int:
                          help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
     p_chaos.add_argument("--drill",
                          choices=["reload", "worker_kill", "host_kill",
-                                  "fleet"],
+                                  "fleet", "autopilot"],
                          default=None,
                          help="additionally drive a drill during the run: "
                               "'reload' POSTs :reload on an interval so "
@@ -167,7 +167,15 @@ def main(argv: list[str] | None = None) -> int:
                               "--model with device_error @ 100%, and "
                               "reports per-model isolation — the victim's "
                               "breaker must open while every survivor "
-                              "holds its SLO (docs/ROBUSTNESS.md)")
+                              "holds its SLO (docs/ROBUSTNESS.md); "
+                              "'autopilot' serves a tenant-fenced fleet "
+                              "with the self-healing controller engaged, "
+                              "turns one tenant hostile mid-load while a "
+                              "seeded latency fault fires on one host, and "
+                              "gates on unattended containment: hostile "
+                              "overage 429'd, victims green, every "
+                              "controller action audited "
+                              "(docs/OPERATIONS.md)")
     p_chaos.add_argument("--drill-interval", type=float, default=0.5,
                          help="seconds between drill operations")
     p_chaos.add_argument("--kill-after", type=float, default=None,
@@ -250,6 +258,16 @@ def main(argv: list[str] | None = None) -> int:
                 cfg, model, duration_s=args.duration, warmup_s=args.warmup,
                 concurrency=args.concurrency, kill_after_s=args.kill_after,
                 reabsorb_budget_s=args.respawn_budget))
+        elif args.drill == "autopilot":
+            # Hostile-tenant drill (ISSUE 16): one tenant floods past its
+            # quota while a seeded [faults] latency rule fires mid-load on
+            # one host; the gated availability is the WORST VICTIM's —
+            # containment must hold without an operator in the loop.
+            from tpuserve.workerproc.drill import run_autopilot_drill
+
+            summary = asyncio.run(run_autopilot_drill(
+                cfg, model, duration_s=args.duration, warmup_s=args.warmup,
+                concurrency=args.concurrency))
         elif args.drill == "fleet":
             # Isolation drill (Clipper P1): --model names the VICTIM; the
             # gated availability is the WORST SURVIVOR's.

@@ -65,6 +65,13 @@ class FaultRuleConfig:
     delay_ms: float = 0.0
     # Rule-local RNG seed; 0 derives one from FaultsConfig.seed + rule index.
     seed: int = 0
+    # Arm the rule only after the injector has been alive this long (s):
+    # a drill's "fault fires MID-load", reproducibly. 0 = armed from boot.
+    after_s: float = 0.0
+    # Restrict the rule to one worker process id (router split): -1 = any
+    # process. Pinning a slow_* rule to one worker makes the fault a
+    # single-host/single-slot event, the autopilot drill's blast shape.
+    worker: int = -1
 
     def __post_init__(self) -> None:
         if self.kind not in FAULT_KINDS:
@@ -72,6 +79,10 @@ class FaultRuleConfig:
                 f"unknown fault kind {self.kind!r}; known: {list(FAULT_KINDS)}")
         if not 0.0 <= self.probability <= 1.0:
             raise ValueError(f"probability must be in [0, 1], got {self.probability}")
+        if self.after_s < 0:
+            raise ValueError(f"faults.rule.after_s must be >= 0, got {self.after_s}")
+        if self.worker < -1:
+            raise ValueError(f"faults.rule.worker must be >= -1, got {self.worker}")
 
 
 @dataclass
@@ -552,6 +563,186 @@ class SchedulerConfig:
 
 
 @dataclass
+class TenantConfig:
+    """One tenant (``[[tenants.tenant]]`` TOML; tpuserve.scheduler.tenants).
+
+    A tenant is an API key plus its containment envelope: a fairness
+    weight, a windowed device-seconds quota, and a request-rate limit.
+    Overage is rejected at admission with 429 + Retry-After — one hostile
+    tenant's flood must cost itself capacity, never its neighbors'."""
+
+    name: str = ""
+    # The key clients present as ``X-Api-Key``. Must be unique and
+    # non-empty.
+    api_key: str = ""
+    # Fairness weight: the tenant's relative share of device time under
+    # saturation, and its share of the result-cache capacity partition.
+    weight: float = 1.0
+    # Device-seconds the tenant may consume per [tenants] window_s window;
+    # 0 = unlimited. Enforced from the windowed ledger at admission
+    # (tenant_quota_exceeded 429s with a drain-based Retry-After).
+    quota_device_s: float = 0.0
+    # Request-rate limit (token bucket, requests/s); 0 = unlimited.
+    rate_per_s: float = 0.0
+    # Token-bucket burst; 0 derives max(1, 2 * rate_per_s).
+    burst: float = 0.0
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            raise ValueError("tenants.tenant.name must be non-empty")
+        if not self.api_key:
+            raise ValueError(
+                f"tenants.tenant {self.name!r}: api_key must be non-empty")
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenants.tenant {self.name!r}: weight must be > 0, "
+                f"got {self.weight}")
+        if self.quota_device_s < 0 or self.rate_per_s < 0 or self.burst < 0:
+            raise ValueError(
+                f"tenants.tenant {self.name!r}: quota_device_s/rate_per_s/"
+                "burst must be >= 0")
+
+
+@dataclass
+class TenantsConfig:
+    """Multi-tenant front door (``[tenants]`` TOML;
+    tpuserve.scheduler.tenants, docs/OPERATIONS.md).
+
+    Off by default. When enabled, every predict request must present a
+    configured ``X-Api-Key`` (401 otherwise, unless ``allow_anonymous``),
+    and admission enforces per-tenant rate, windowed device-seconds quota,
+    and — under fleet saturation — weighted fair share, all from one
+    sliding-window weighted device-seconds ledger (the PR 10 per-model
+    ledger grown one dimension). The result cache partitions its capacity
+    by tenant weight so one tenant's churn cannot evict another's hits,
+    and each tenant gets its own SLO burn gauges over
+    ``tenant_latency_ms{tenant=}``."""
+
+    enabled: bool = False
+    # Sliding window (s) for the per-tenant device-seconds ledger.
+    window_s: float = 60.0
+    # Admit requests with no/unknown API key as the tenant named here
+    # ("" = reject them with 401). The anonymous tenant gets weight 1 and
+    # no quota/rate unless a [[tenants.tenant]] entry names it explicitly.
+    allow_anonymous: str = ""
+    # Multiplier of slack over a tenant's weighted fair share before
+    # share-based shedding fires under saturation (tenant_share_exceeded);
+    # 0 disables fair-share shedding (rate + quota still enforce).
+    share_slack: float = 1.25
+    # Per-tenant SLO over tenant_latency_ms{tenant=}: latency objective
+    # (ms; 0 disables per-tenant burn evaluation), availability target,
+    # and burn-alert threshold — same semantics as [model.slo].
+    slo_latency_ms: float = 0.0
+    slo_availability: float = 0.999
+    slo_burn_alert: float = 10.0
+    tenants: list[TenantConfig] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if self.window_s <= 0:
+            raise ValueError(
+                f"tenants.window_s must be > 0, got {self.window_s}")
+        if self.share_slack < 0:
+            raise ValueError(
+                f"tenants.share_slack must be >= 0, got {self.share_slack}")
+        if self.slo_latency_ms < 0:
+            raise ValueError(
+                f"tenants.slo_latency_ms must be >= 0, got {self.slo_latency_ms}")
+        if not 0.0 < self.slo_availability < 1.0:
+            raise ValueError(
+                f"tenants.slo_availability must be in (0, 1), "
+                f"got {self.slo_availability}")
+        if self.slo_burn_alert <= 0:
+            raise ValueError(
+                f"tenants.slo_burn_alert must be > 0, got {self.slo_burn_alert}")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"tenants.tenant names must be unique: {names}")
+        keys = [t.api_key for t in self.tenants]
+        if len(set(keys)) != len(keys):
+            raise ValueError("tenants.tenant api_keys must be unique")
+
+
+@dataclass
+class AutopilotConfig:
+    """Self-healing fleet controller (``[autopilot]`` TOML;
+    tpuserve.scheduler.autopilot, docs/OPERATIONS.md "Self-operating
+    fleet").
+
+    Off by default. When enabled on the primary router, a background
+    reconcile loop reads SLO burn state, fleet queue pressure, and
+    predicted clear time every ``interval_s`` and acts through the same
+    audited verbs an operator would use: scale worker slots per host
+    domain up/down, engage/clear shed-on-burn per model, and (with
+    ``paging``) warm/demote models under a cross-model budget. Every
+    decision is damped by hysteresis (``hysteresis_ticks`` consecutive
+    ticks over threshold), a per-(action, target) cooldown, and a bounded
+    action budget per window; every action opens a follow-up watch and is
+    rolled back when the objective got WORSE. Every decision — rollbacks
+    included — lands in the audit trail with its triggering signal
+    values."""
+
+    enabled: bool = False
+    # Reconcile tick cadence (s).
+    interval_s: float = 0.5
+    # Consecutive ticks a trigger condition must hold before acting.
+    hysteresis_ticks: int = 3
+    # Per-(action kind, target) cooldown (s): the same knob is not touched
+    # twice within it (rollbacks are exempt — undo must never wait).
+    cooldown_s: float = 10.0
+    # Action budget: at most this many non-rollback actions per window_s.
+    max_actions_per_window: int = 8
+    window_s: float = 60.0
+    # Follow-up watch: this long after an action the objective is
+    # re-measured; if it got worse by more than rollback_tolerance the
+    # action is inverted (audited as outcome "rollback"). 0 disables.
+    follow_up_s: float = 15.0
+    rollback_tolerance: float = 0.5
+    # Queue-pressure thresholds (mean in-flight relays per active healthy
+    # worker slot): above high -> scale a domain up; below low with no
+    # model burning -> scale down. high must exceed low.
+    pressure_high: float = 2.0
+    pressure_low: float = 0.25
+    # Predicted queue-clear time (s) that also triggers scale-up when the
+    # signal is available; 0 disables the clear-time trigger.
+    clear_high_s: float = 0.0
+    # Never scale a domain below this many active slots.
+    min_slots: int = 1
+    # Allow shed-on-burn actions: a model FIRING its burn alert gets its
+    # batch-class traffic shed at the front door until the alert clears.
+    burn_shed: bool = True
+    # Allow scale actions against host domains.
+    scale: bool = True
+    # Allow warm/demote paging actions (fan out :warm / :demote to the
+    # workers). Off by default: paging needs [scheduler] cold_start models.
+    paging: bool = False
+    # Cross-model device-memory budget for paging: max concurrently warm
+    # models; 0 = unlimited (demote only on idle sweep).
+    max_warm: int = 0
+    # Decision records retained for GET /debug/autopilot.
+    history: int = 256
+
+    def __post_init__(self) -> None:
+        if self.interval_s <= 0 or self.window_s <= 0:
+            raise ValueError(
+                "autopilot.interval_s/window_s must be > 0")
+        if self.hysteresis_ticks < 1 or self.max_actions_per_window < 1 \
+                or self.min_slots < 1 or self.history < 1:
+            raise ValueError(
+                "autopilot.hysteresis_ticks/max_actions_per_window/"
+                "min_slots/history must be >= 1")
+        if self.cooldown_s < 0 or self.follow_up_s < 0 \
+                or self.rollback_tolerance < 0 or self.clear_high_s < 0 \
+                or self.max_warm < 0:
+            raise ValueError(
+                "autopilot.cooldown_s/follow_up_s/rollback_tolerance/"
+                "clear_high_s/max_warm must be >= 0")
+        if not 0.0 <= self.pressure_low < self.pressure_high:
+            raise ValueError(
+                f"autopilot.pressure_low must be in [0, pressure_high), got "
+                f"low={self.pressure_low} high={self.pressure_high}")
+
+
+@dataclass
 class RouterConfig:
     """Router/worker process split (``[router]`` TOML; tpuserve.workerproc,
     docs/ROBUSTNESS.md "Process failure domains").
@@ -627,10 +818,19 @@ class RouterConfig:
     # Worker boot budget (spawn -> ready handshake), seconds. Generous:
     # a cold worker AOT-compiles every bucket.
     spawn_timeout_s: float = 900.0
+    # Initial ACTIVE worker slots per host domain (autopilot scaling seam):
+    # slots beyond this boot scaled-down and cost nothing until the
+    # controller (or an operator via /admin/hosts/{hid}:scale) activates
+    # them. 0 = all `workers` slots active (the pre-autopilot behavior).
+    active_workers: int = 0
 
     def __post_init__(self) -> None:
         if self.workers < 1:
             raise ValueError(f"router.workers must be >= 1, got {self.workers}")
+        if self.active_workers < 0 or self.active_workers > self.workers:
+            raise ValueError(
+                f"router.active_workers must be in [0, workers], got "
+                f"{self.active_workers}")
         if self.retry_max < 0 or self.hedge_ms < 0:
             raise ValueError("router.retry_max/hedge_ms must be >= 0")
         if self.respawn_initial_s < 0 or self.respawn_max_s <= 0 \
@@ -924,6 +1124,14 @@ class ServerConfig:
     # Structured event plane + crash-forensics black box + admin audit
     # trail (docs/OBSERVABILITY.md "The third pillar"). On by default.
     events: EventsConfig = field(default_factory=EventsConfig)
+    # Multi-tenant front door: per-tenant API keys, weighted device-seconds
+    # ledger, quota/rate/fair-share admission, partitioned result cache,
+    # per-tenant SLO burn (docs/OPERATIONS.md). Off by default.
+    tenants: TenantsConfig = field(default_factory=TenantsConfig)
+    # Self-healing fleet controller: reconcile loop acting through audited
+    # admin verbs with hysteresis/cooldown/budget/rollback
+    # (docs/OPERATIONS.md "Self-operating fleet"). Off by default.
+    autopilot: AutopilotConfig = field(default_factory=AutopilotConfig)
     # Emit one JSON object per log line (machine-ingestible) instead of the
     # human-readable default.
     log_json: bool = False
@@ -991,6 +1199,8 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
     router_dict = raw.pop("router", None)
     worker_dict = raw.pop("worker", None)
     faults_dict = raw.pop("faults", None)
+    tenants_dict = raw.pop("tenants", None)
+    autopilot_dict = raw.pop("autopilot", None)
     lifecycle_dict = raw.pop("lifecycle", None)
     pipeline_dict = raw.pop("pipeline", None)
     cache_dict = raw.pop("cache", None)
@@ -1035,6 +1245,14 @@ def load_config(path: str | None = None, overrides: list[str] | None = None) -> 
         rule_dicts = faults_dict.pop("rule", [])
         cfg.faults = _build(FaultsConfig, faults_dict)
         cfg.faults.rules = [_build(FaultRuleConfig, r) for r in rule_dicts]
+    if tenants_dict is not None:
+        # [[tenants.tenant]] entries are nested sub-tables of [tenants].
+        tenant_dicts = tenants_dict.pop("tenant", [])
+        cfg.tenants = _build(TenantsConfig, tenants_dict)
+        cfg.tenants.tenants = [_build(TenantConfig, t) for t in tenant_dicts]
+        cfg.tenants.__post_init__()  # re-check uniqueness with the list set
+    if autopilot_dict is not None:
+        cfg.autopilot = _build(AutopilotConfig, autopilot_dict)
 
     for ov in overrides or []:
         _apply_override(cfg, ov)

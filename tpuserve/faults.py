@@ -47,6 +47,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from typing import Callable
 
 from tpuserve.config import FaultRuleConfig, FaultsConfig
@@ -93,6 +94,13 @@ class FaultInjector:
         self.cfg = cfg
         self.metrics = metrics
         self._lock = new_lock("faults.FaultInjector")
+        # Epoch for rule.after_s gating: rules with after_s > 0 stay cold
+        # until the injector has been alive that long, so a drill can arm a
+        # fault that reproducibly fires MID-load rather than from boot.
+        self._born = time.monotonic()
+        # Worker-process id for rule.worker pinning (set by the serving
+        # process under the router split); None/-1 rules match any process.
+        self.worker_id: int | None = None
         # Derived seeds keep distinct rules decorrelated even when the
         # operator leaves every rule.seed at 0.
         self._rules = [_ArmedRule(r, cfg.seed * 1000003 + i + 1)
@@ -116,7 +124,12 @@ class FaultInjector:
         if not self.cfg.enabled:
             return None
         with self._lock:
+            alive_s = time.monotonic() - self._born
             for rule in self._rules:
+                if rule.cfg.after_s > 0 and alive_s < rule.cfg.after_s:
+                    continue
+                if rule.cfg.worker >= 0 and rule.cfg.worker != self.worker_id:
+                    continue
                 if rule.matches(kind, model) and rule.draw():
                     if self.metrics is not None:
                         self.metrics.counter(

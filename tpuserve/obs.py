@@ -560,7 +560,11 @@ SLO_ALERT_STATES = {"ok": 0.0, "pending": 1.0, "firing": 2.0}
 # shed while another model's interactive traffic was starved below
 # min_share; "model_warming" — shed during a cold model's warming window.
 SCHED_SHED_REASONS = ("deadline_unmeetable", "priority_shed",
-                      "share_exceeded", "model_warming")
+                      "share_exceeded", "model_warming", "burn_shed")
+
+# Tenant admission rejections (tpuserve.scheduler.tenants), by cause.
+TENANT_SHED_REASONS = ("tenant_unknown", "tenant_rate_exceeded",
+                       "tenant_quota_exceeded", "tenant_share_exceeded")
 
 
 class Metrics:
@@ -768,19 +772,55 @@ class Metrics:
         return self.gauge(
             f"device_utilization{{model={model},replica={replica}}}")
 
-    def slo_burn_gauge(self, model: str, window_s: float) -> Gauge:
+    def slo_burn_gauge(self, model: str, window_s: float,
+                       label: str = "model") -> Gauge:
         """slo_burn_rate{model=,window=}: the model's error-budget burn
         rate over one [telemetry] burn window (bad fraction / budget;
         1.0 = spending the budget exactly at the sustainable pace).
-        Updated every sampler tick (tpuserve.telemetry.slo)."""
+        Updated every sampler tick (tpuserve.telemetry.slo). ``label``
+        swaps the subject dimension — the tenant SLO engine burns
+        slo_burn_rate{tenant=,window=} through the same machinery."""
         return self.gauge(
-            f"slo_burn_rate{{model={model},window={window_s:g}s}}")
+            f"slo_burn_rate{{{label}={model},window={window_s:g}s}}")
 
-    def set_slo_alert_state(self, model: str, state: str) -> None:
+    def set_slo_alert_state(self, model: str, state: str,
+                            label: str = "model") -> None:
         """slo_alert_state{model=}: the /alerts state as a gauge
-        (SLO_ALERT_STATES: ok 0 / pending 1 / firing 2)."""
-        self.gauge(f"slo_alert_state{{model={model}}}").set(
+        (SLO_ALERT_STATES: ok 0 / pending 1 / firing 2). ``label`` as in
+        slo_burn_gauge (tenant alerts are slo_alert_state{tenant=})."""
+        self.gauge(f"slo_alert_state{{{label}={model}}}").set(
             SLO_ALERT_STATES[state])
+
+    def tenant_requests_counter(self, tenant: str) -> Counter:
+        """tenant_requests_total{tenant=}: predict requests admitted for
+        one tenant. Prebound by the tenant ledger — never call per
+        request."""
+        return self.counter(f"tenant_requests_total{{tenant={tenant}}}")
+
+    def tenant_shed_counter(self, tenant: str, reason: str) -> Counter:
+        """tenant_sheds_total{tenant=,reason=}: requests refused at the
+        tenant front door, by reason (one of TENANT_SHED_REASONS)."""
+        return self.counter(
+            f"tenant_sheds_total{{tenant={tenant},reason={reason}}}")
+
+    def tenant_device_seconds_counter(self, tenant: str) -> Counter:
+        """tenant_device_seconds_total{tenant=}: cumulative device-time
+        proxy one tenant consumed — the windowed form drives quota and
+        fair-share admission (tpuserve.scheduler.tenants)."""
+        return self.counter(
+            f"tenant_device_seconds_total{{tenant={tenant}}}")
+
+    def tenant_latency_histogram(self, tenant: str) -> Histogram:
+        """tenant_latency_ms{tenant=}: end-to-end predict latency per
+        tenant (the substrate the per-tenant SLO burn engine reads)."""
+        return self.histogram(f"tenant_latency_ms{{tenant={tenant}}}")
+
+    def autopilot_action_counter(self, kind: str, outcome: str) -> Counter:
+        """autopilot_actions_total{kind=,outcome=}: fleet-controller
+        decisions by action kind (scale_up/scale_down/shed_on/shed_off/
+        warm/demote) and outcome (ok/error/rollback)."""
+        return self.counter(
+            f"autopilot_actions_total{{kind={kind},outcome={outcome}}}")
 
     def set_model_state(self, model: str, state: str) -> None:
         """model_state{model=}: the warm/cold paging state as a gauge

@@ -120,6 +120,12 @@ class FleetScheduler:
         # shed-on-burn seam — a future PR sheds batch-class work for a
         # FIRING model instead of waiting for fleet-wide saturation.
         self.slo = None
+        # Shed-on-burn engagement set (ISSUE 16): models the autopilot
+        # (or an operator) has marked burning. While a model is in here
+        # its batch-class work sheds at admission with reason
+        # ``burn_shed`` — interactive traffic keeps flowing, the backlog
+        # that is burning the budget does not grow.
+        self.burn_shed: set[str] = set()
 
     # -- registration ---------------------------------------------------------
     def register(self, name: str, batcher: Any, mcfg: Any,
@@ -239,6 +245,14 @@ class FleetScheduler:
                 e, 503, "model_warming",
                 f"model {model!r} is {e.state}; weights are being staged",
                 eta)
+        if priority == "batch" and model in self.burn_shed:
+            # Shed-on-burn engaged: the model is burning its error budget,
+            # so deferrable work yields before saturation math even runs.
+            return self._shed(
+                e, 503, "burn_shed",
+                f"model {model!r} is burning its SLO error budget; "
+                "batch-priority work shed until the alert clears",
+                clamp_retry_after_s(self.cfg.overload_clear_s) or 1)
         if not self.saturated():
             return None
         agg_hint = clamp_retry_after_s(sum(

@@ -64,7 +64,7 @@ from tpuserve.batcher import (DeadlineExceeded, ModelBatcher, QueueFull,
                               clamp_retry_after_s)
 from tpuserve.bench.roofline import compute_split, phase_p50
 from tpuserve.cache import ModelCache
-from tpuserve.config import ServerConfig
+from tpuserve.config import ServerConfig, SloConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
 from tpuserve.genserve import GenEngine
 from tpuserve.hostpipe import StageExecutors
@@ -73,6 +73,7 @@ from tpuserve.obs import (PRIORITIES, FlightRecorder, Metrics, TraceContext,
                           exposition_content_type, spans_to_chrome)
 from tpuserve.runtime import ModelRuntime, build_runtime, configure_jax
 from tpuserve.scheduler import FleetScheduler
+from tpuserve.scheduler.tenants import TenantLedger
 from tpuserve.telemetry import (AuditLog, BlackBoxWriter, EventLog,
                                 MetricSampler, PostmortemLog, ProfileCapture,
                                 SloEngine, TimeSeriesStore,
@@ -204,6 +205,14 @@ class ServerState:
         # fully independent, exactly as before.
         self.scheduler = (FleetScheduler(cfg.scheduler, self.metrics)
                           if cfg.scheduler.enabled else None)
+        # Tenant containment (ISSUE 16): X-Api-Key resolution + the
+        # weighted device-seconds ledger, enforced at admission in
+        # _predict_traced. The fleet scheduler's saturation signal gates
+        # fair-share shedding; without a scheduler only rate + quota run.
+        self.tenants = (TenantLedger(cfg.tenants, self.metrics)
+                        if cfg.tenants.enabled else None)
+        if self.tenants is not None and self.scheduler is not None:
+            self.tenants.saturated_fn = self.scheduler.saturated
         self.canary_ok: dict[str, bool] = {}
         # Telemetry plane (ISSUE 14, docs/OBSERVABILITY.md "The telemetry
         # plane"): bounded time-series history over every metric, the SLO
@@ -213,6 +222,7 @@ class ServerState:
         self.store: TimeSeriesStore | None = None
         self.sampler: MetricSampler | None = None
         self.slo: SloEngine | None = None
+        self.tenant_slo: SloEngine | None = None
         self.util: UtilizationDeriver | None = None
         self.profiler: ProfileCapture | None = None
         if cfg.telemetry.enabled:
@@ -224,9 +234,24 @@ class ServerState:
                                  tcfg.burn_windows_s)
             self.util = UtilizationDeriver(self.metrics, self.store,
                                            tcfg.utilization_window_s)
+            hooks = [self.slo.tick, self.util.tick]
+            if self.tenants is not None and cfg.tenants.slo_latency_ms > 0:
+                # Per-tenant SLO burn (ISSUE 16 satellite): the same
+                # burn-rate machinery over tenant_latency_ms{tenant=},
+                # one shared objective from [tenants].
+                self.tenant_slo = SloEngine(
+                    self.metrics, self.store, tcfg.burn_windows_s,
+                    metric_fmt="tenant_latency_ms{{tenant={name}}}",
+                    label="tenant")
+                tenant_slo_cfg = SloConfig(
+                    latency_ms=cfg.tenants.slo_latency_ms,
+                    availability=cfg.tenants.slo_availability,
+                    burn_alert=cfg.tenants.slo_burn_alert)
+                for tname in self.tenants.names():
+                    self.tenant_slo.register(tname, tenant_slo_cfg)
+                hooks.append(self.tenant_slo.tick)
             self.sampler = MetricSampler(
-                self.store, tcfg.sample_interval_s,
-                hooks=[self.slo.tick, self.util.tick])
+                self.store, tcfg.sample_interval_s, hooks=hooks)
             self.profiler = ProfileCapture(self.metrics)
         # Structured event plane (ISSUE 15, docs/OBSERVABILITY.md "The
         # third pillar"): bounded event ring + logging bridge, admin audit
@@ -443,6 +468,14 @@ class ServerState:
                     name, batcher=b, mcfg=model.cfg, runtime=rt,
                     warm_fn=lc.reload if lc is not None else None,
                     cold=bool(model.cfg.cold_start))
+        if self.tenants is not None:
+            # Tenant-partitioned cache capacity (ISSUE 16): each tenant's
+            # weighted share bounds how many entries its misses may pin,
+            # so one tenant's flood churns its OWN share first. Hits stay
+            # content-addressed across tenants.
+            weights = self.tenants.weights()
+            for c in self.caches.values():
+                c.set_tenant_weights(weights)
         # Native-decode fallback observability (ISSUE 11 satellite): the
         # preproc yuv420 decoder reports every PIL fallback on a
         # native-eligible request; route it to the prebound per-model
@@ -830,6 +863,7 @@ async def _submit_and_gather(state: ServerState, name: str, model,
                              priority: str | None,
                              timeout_ms: float | None,
                              ctx: "TraceContext | None" = None,
+                             tenant: str | None = None,
                              ) -> tuple[list, "object | None"]:
     """Cache/single-flight lookup + batcher submission + deadline-bounded
     gather for one decoded request — everything that must run on the main
@@ -859,7 +893,7 @@ async def _submit_and_gather(state: ServerState, name: str, model,
                     key, lambda it=item: batcher.submit(
                         it, group=model.group_key(it),
                         deadline_at=deadline_at, priority=priority,
-                        ctx=ctx), ctx=ctx)
+                        ctx=ctx), ctx=ctx, tenant=tenant)
             else:
                 fut = batcher.submit(item, group=model.group_key(item),
                                      deadline_at=deadline_at,
@@ -949,6 +983,23 @@ async def _predict_traced(request: web.Request, state: ServerState,
     if state.draining:
         return _err(503, "server draining; retry against another replica",
                     retry_after=state.shed_retry_after(), trace=ctx)
+    # Tenant containment (ISSUE 16): identity, rate, quota, and fair
+    # share are judged pre-body — a flooding tenant is refused in
+    # microseconds and never reaches decode or the batcher. Behind the
+    # router tier the ROUTER admits (it fronts clients); the worker's
+    # [tenants] block is normally disabled there.
+    tenant: str | None = None
+    if state.tenants is not None:
+        tenant = state.tenants.resolve(request.headers.get("X-Api-Key"))
+        if tenant is None:
+            t_shed = state.tenants.shed_unknown()
+            return _err(t_shed.status, t_shed.message, reason=t_shed.reason,
+                        trace=ctx)
+        t_shed = state.tenants.admit(tenant)
+        if t_shed is not None:
+            return _err(t_shed.status, t_shed.message,
+                        retry_after=t_shed.retry_after,
+                        reason=t_shed.reason, trace=ctx)
     breaker = state.breakers.get(name)
     if breaker is not None and not breaker.allow():
         breaker.on_shed()
@@ -1097,7 +1148,7 @@ async def _predict_traced(request: web.Request, state: ServerState,
         results, hit_entry = await _on_main(
             state, lambda: _submit_and_gather(
                 state, name, model, items, deadline_at, priority,
-                timeout_ms, ctx))
+                timeout_ms, ctx, tenant))
     except QueueFull:
         return _err(429, "queue full, retry later",
                     retry_after=state.queue_retry_after(name), trace=ctx)
@@ -1124,6 +1175,12 @@ async def _predict_traced(request: web.Request, state: ServerState,
 
     total_ms = (time.perf_counter() - t_start) * 1e3
     h.total_hist.observe(total_ms, trace_id=ctx.trace_id)
+    if state.tenants is not None and tenant is not None:
+        # Charge the tenant's sliding-window ledger with the wall time
+        # the request occupied the server (the device-time proxy quota
+        # and fair share enforce) and feed its latency series (the
+        # per-tenant SLO burn input).
+        state.tenants.record(tenant, total_ms / 1e3, latency_ms=total_ms)
     if batched:
         payload = {"results": results}
         if len(results) >= _JSON_OFFLOAD_MIN_ITEMS and not state.cfg.decode_inline:
@@ -1348,6 +1405,10 @@ async def handle_stats(request: web.Request) -> web.Response:
     # live completion predictions, and shed accounting.
     if state.scheduler is not None:
         out["scheduler"] = state.scheduler.stats()
+    # Tenant containment (ISSUE 16): per-tenant envelopes + live window
+    # usage; the full view (with SLO burn) is at /tenants.
+    if state.tenants is not None:
+        out["tenants"] = state.tenants.usage()
     # Demand-shaping layer: per-model result-cache occupancy and the
     # hit/miss/coalesced/stale accounting (docs/PERFORMANCE.md).
     if state.caches:
@@ -1589,6 +1650,57 @@ async def handle_warm(request: web.Request) -> web.Response:
     return web.json_response(info)
 
 
+async def handle_demote(request: web.Request) -> web.Response:
+    """POST /admin/models/{name}:demote — release a warm cold_start
+    model's device params back to cold (the autopilot's warm-budget
+    actuator, and an operator's manual page-out). Idempotent: demoting a
+    cold (or non-cold_start) model answers 200 with demoted = false.
+    409 when the fleet scheduler is not enabled."""
+    state: ServerState = request.app[STATE_KEY]
+    name = request.match_info["name"]
+    if name not in state.runtimes:
+        return _err(404, f"unknown model {name!r}")
+    if state.scheduler is None:
+        return _err(409, "the fleet scheduler ([scheduler] enabled) owns "
+                         "warm/cold states; it is not enabled")
+    t0 = time.perf_counter()
+    try:
+        demoted = state.scheduler.demote(name)
+    except Exception as e:  # noqa: BLE001 — a failed demote keeps it warm
+        if state.audit is not None:
+            state.audit.record(
+                "demote", name, "error",
+                duration_ms=(time.perf_counter() - t0) * 1e3, error=str(e))
+        return _err(500, f"demote failed (model stays warm): {e}")
+    if state.audit is not None:
+        state.audit.record(
+            "demote", name, "ok",
+            duration_ms=(time.perf_counter() - t0) * 1e3, demoted=demoted)
+    return web.json_response({"model": name, "demoted": demoted})
+
+
+async def handle_tenants(request: web.Request) -> web.Response:
+    """GET /tenants — per-tenant containment envelopes + live window
+    usage (ISSUE 16). ``?tenant=`` narrows to one tenant's row; any other
+    query param is a 400 (the shared validator)."""
+    state: ServerState = request.app[STATE_KEY]
+    try:
+        events_mod.reject_unknown_query(request.query, {"tenant"})
+    except ValueError as e:
+        return _err(400, str(e))
+    if state.tenants is None:
+        return _err(409, "[tenants] is disabled; no tenant ledger is kept")
+    body = state.tenants.usage()
+    if state.tenant_slo is not None:
+        body["slo"] = state.tenant_slo.alerts()
+    want = request.query.get("tenant")
+    if want is not None:
+        if want not in body["tenants"]:
+            return _err(404, f"unknown tenant {want!r}")
+        body["tenants"] = {want: body["tenants"][want]}
+    return web.json_response(body)
+
+
 async def handle_index(request: web.Request) -> web.Response:
     return web.Response(text=_INDEX_HTML, content_type="text/html")
 
@@ -1668,6 +1780,8 @@ def make_app(state: ServerState, loop_index: int = 0,
                         _main_loop_handler(handle_rollback))
     app.router.add_post("/admin/models/{name}:warm",
                         _main_loop_handler(handle_warm))
+    app.router.add_post("/admin/models/{name}:demote",
+                        _main_loop_handler(handle_demote))
     app.router.add_get("/admin/models/{name}/versions",
                        _main_loop_handler(handle_versions))
     app.router.add_get("/healthz", handle_healthz)
@@ -1686,6 +1800,9 @@ def make_app(state: ServerState, loop_index: int = 0,
     app.router.add_get("/debug/events", handle_events)
     app.router.add_get("/debug/postmortems", handle_postmortems)
     app.router.add_get("/debug/audit", handle_audit)
+    # Tenant containment (ISSUE 16): the ledger is locked — safe from any
+    # accept loop.
+    app.router.add_get("/tenants", handle_tenants)
     app.router.add_get("/", handle_index)
 
     if primary:

@@ -360,16 +360,36 @@ def emit(level: str, subsystem: str, event: str, **kw) -> None:
         log.emit(level, subsystem, event, **kw)
 
 
-def parse_events_query(query) -> dict:
-    """Validate /debug/events query params (the /debug/trace hardening
-    discipline: junk is a 400, never a 500 or a silent default). Raises
-    ValueError with a client-facing message."""
-    out: dict = {}
-    known = {"since_us", "level", "subsystem", "trace_id", "limit"}
-    unknown = set(query) - known
+def reject_unknown_query(query, known) -> None:
+    """The shared half of introspection-endpoint query hardening (the
+    /debug/trace discipline: junk is a 400, never a 500 or a silent
+    default). Every read-only debug/admin view — /debug/events,
+    /debug/autopilot, /tenants — runs its params through this one check
+    so a typo'd filter fails identically everywhere. Raises ValueError
+    with a client-facing message."""
+    unknown = set(query) - set(known)
     if unknown:
         raise ValueError(f"unknown query param(s): {sorted(unknown)} "
                          f"(known: {sorted(known)})")
+
+
+def query_limit(query, default: int = 1000) -> int:
+    """Parse the conventional ``limit`` param (int, >= 0)."""
+    try:
+        limit = int(query.get("limit", str(default)))
+    except (TypeError, ValueError):
+        raise ValueError("limit must be an integer") from None
+    if limit < 0:
+        raise ValueError(f"limit must be >= 0, got {limit}")
+    return limit
+
+
+def parse_events_query(query) -> dict:
+    """Validate /debug/events query params. Raises ValueError with a
+    client-facing message."""
+    out: dict = {}
+    reject_unknown_query(
+        query, {"since_us", "level", "subsystem", "trace_id", "limit"})
     if "since_us" in query:
         try:
             out["since_us"] = float(query["since_us"])
@@ -386,12 +406,7 @@ def parse_events_query(query) -> dict:
         out["subsystem"] = query["subsystem"]
     if query.get("trace_id"):
         out["trace_id"] = query["trace_id"]
-    try:
-        out["limit"] = int(query.get("limit", "1000"))
-    except (TypeError, ValueError):
-        raise ValueError("limit must be an integer") from None
-    if out["limit"] < 0:
-        raise ValueError(f"limit must be >= 0, got {out['limit']}")
+    out["limit"] = query_limit(query)
     return out
 
 

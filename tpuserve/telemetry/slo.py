@@ -71,11 +71,11 @@ class _ModelSlo:
     __slots__ = ("name", "slo", "metric", "burn_gauges", "state", "since")
 
     def __init__(self, name: str, slo, metric: str, metrics: Metrics,
-                 windows: list[float]) -> None:
+                 windows: list[float], label: str = "model") -> None:
         self.name = name
         self.slo = slo
         self.metric = metric
-        self.burn_gauges = {w: metrics.slo_burn_gauge(name, w)
+        self.burn_gauges = {w: metrics.slo_burn_gauge(name, w, label=label)
                             for w in windows}
         self.state = OK
         self.since = time.time()
@@ -94,11 +94,15 @@ class SloEngine:
     def __init__(self, metrics: Metrics, store: TimeSeriesStore,
                  windows: list[float],
                  metric_fmt: str = "latency_ms{{model={name},phase=total}}",
-                 ) -> None:
+                 label: str = "model") -> None:
         self.metrics = metrics
         self.store = store
         self.windows = list(windows)
         self.metric_fmt = metric_fmt
+        # Subject dimension of the exported gauges: "model" for the
+        # serving engines, "tenant" for the per-tenant burn engine (same
+        # state machine over tenant_latency_ms{tenant=}).
+        self.label = label
         self._models: dict[str, _ModelSlo] = {}
         self._lock = new_lock("telemetry.SloEngine")
 
@@ -108,10 +112,10 @@ class SloEngine:
         if slo is None or slo.latency_ms <= 0:
             return False
         m = _ModelSlo(name, slo, self.metric_fmt.format(name=name),
-                      self.metrics, self.windows)
+                      self.metrics, self.windows, label=self.label)
         with self._lock:
             self._models[name] = m
-        self.metrics.set_slo_alert_state(name, OK)
+        self.metrics.set_slo_alert_state(name, OK, label=self.label)
         return True
 
     # -- evaluation (sampler thread) -----------------------------------------
@@ -151,7 +155,8 @@ class SloEngine:
                 if new_state != m.state:
                     m.state = new_state
                     m.since = time.time()
-            self.metrics.set_slo_alert_state(name, new_state)
+            self.metrics.set_slo_alert_state(name, new_state,
+                                             label=self.label)
 
     # -- reads (HTTP / scheduler) --------------------------------------------
     def state_of(self, name: str) -> str:

@@ -1,11 +1,12 @@
 """Kill-worker chaos drill (``python -m tpuserve chaos --drill worker_kill``;
 PAPERS.md P6 — a resilience property you haven't injected a fault against
-is a hope, not a property).
+is a hope, not a property), plus the hostile-tenant autopilot drill
+(``--drill autopilot``, ISSUE 16).
 
-The drill serves a REAL router + N worker processes on an ephemeral port,
-drives the closed-loop load generator at one model, then SIGKILLs one
-worker mid-load (uncatchable — exactly a native crash / OOM kill) and
-measures the properties the process split promises:
+The kill drills serve a REAL router + N worker processes on an ephemeral
+port, drive the closed-loop load generator at one model, then SIGKILL one
+worker (or one whole host's process group) mid-load and measure the
+properties the process split promises:
 
 - **availability** — n_ok / (n_ok + n_err) over the whole run, kill
   included, must hold the bound (default >= 99%): in-flight requests on
@@ -344,3 +345,218 @@ async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None
         "respawn_backoff_initial_s": cfg.router.respawn_initial_s,
     }
     return out
+
+
+async def _tenant_load(url: str, payload: bytes, ctype: str, api_key: str,
+                       stop: asyncio.Event, out: dict, clients: int,
+                       think_s: float = 0.0) -> None:
+    """Closed-loop per-tenant load: ``clients`` concurrent callers, each
+    tagging ``X-Api-Key`` and bucketing every response by status + shed
+    reason. A hostile tenant is just this with no think time and a tight
+    envelope — it deliberately ignores Retry-After."""
+    import aiohttp
+
+    headers = {"Content-Type": ctype, "X-Api-Key": api_key}
+
+    async def _one() -> None:
+        async with aiohttp.ClientSession() as session:
+            while not stop.is_set():
+                try:
+                    async with session.post(
+                            url, data=payload, headers=headers,
+                            timeout=aiohttp.ClientTimeout(total=30.0)) as r:
+                        if r.status == 200:
+                            await r.read()
+                            out["n_200"] += 1
+                        else:
+                            key = f"n_{r.status}" \
+                                if r.status in (429, 503) else "n_other"
+                            out[key] = out.get(key, 0) + 1
+                            try:
+                                reason = (await r.json()).get("reason", "")
+                            except Exception:  # noqa: BLE001
+                                reason = ""
+                            if reason:
+                                out["reasons"][reason] = \
+                                    out["reasons"].get(reason, 0) + 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — transport-level failure
+                    out["transport_errors"] += 1
+                if think_s:
+                    await asyncio.sleep(think_s)
+
+    await asyncio.gather(*(_one() for _ in range(clients)))
+
+
+async def run_autopilot_drill(cfg: ServerConfig, model_name: str | None = None,
+                              duration_s: float = 25.0, warmup_s: float = 1.0,
+                              concurrency: int = 16) -> dict:
+    """Hostile-tenant autopilot drill (ISSUE 16; the closed-loop tentpole):
+    serve a router fleet with the autopilot engaged and per-tenant
+    containment on, then — unattended — let one tenant turn hostile (a
+    quota-busting flood) while any seeded ``[faults]`` latency rule fires
+    mid-load, and report the evidence the self-healing loop promises:
+
+    - **containment** — the hostile tenant's overage is 429'd at admission
+      (tenant_* shed reasons) while the victim tenant's availability (the
+      ``availability`` the CLI gates) stays green;
+    - **reaction** — the controller sheds/scales within the run: its
+      decision log shows actions, and ``first_action_s`` bounds the
+      reaction time from load start;
+    - **audit** — every controller decision (rollbacks included) is
+      readable from GET /debug/audit as an ``autopilot:*`` verb, fetched
+      over HTTP from the live fleet, not from in-process state.
+
+    The caller owns asserting the bounds (the CLI gates availability;
+    scripts/autopilot_drill.sh gates the rest)."""
+    import aiohttp
+    from aiohttp import web
+
+    from tpuserve.bench.loadgen import synthetic_image_npy
+    from tpuserve.config import TenantConfig
+    from tpuserve.workerproc.router import RouterState, make_router_app
+
+    cfg.router.enabled = True
+    cfg.router.hosts = max(2, cfg.router.hosts)
+    cfg.router.workers = max(2, cfg.router.workers)  # per host
+    if not 1 <= cfg.router.active_workers < cfg.router.workers:
+        # Leave one dormant slot per host so scale_up has real headroom.
+        cfg.router.active_workers = cfg.router.workers - 1
+    # Identical payloads would all coalesce into one cache hit, hiding
+    # both the hostile load and the pressure signal the controller reads.
+    cfg.cache.enabled = False
+
+    ap = cfg.autopilot
+    ap.enabled = True
+    # Drill runs tens of seconds, not hours: tighten the controller's
+    # clocks so hysteresis/cooldown/follow-up all fit inside the run
+    # (never loosen what the config already set tighter).
+    ap.interval_s = min(ap.interval_s, 0.25)
+    ap.hysteresis_ticks = min(ap.hysteresis_ticks, 2)
+    ap.cooldown_s = min(ap.cooldown_s, 3.0)
+    ap.follow_up_s = min(ap.follow_up_s, 5.0)
+
+    tn = cfg.tenants
+    tn.enabled = True
+    have = {t.name for t in tn.tenants}
+    if "hostile" not in have:
+        # Tight envelope: the flood must hit its quota mid-run.
+        tn.tenants.append(TenantConfig(
+            name="hostile", api_key="drill-hostile-key", weight=1.0,
+            quota_device_s=max(1.0, duration_s * 0.2),
+            rate_per_s=float(concurrency)))
+    if "victim" not in have:
+        tn.tenants.append(TenantConfig(
+            name="victim", api_key="drill-victim-key", weight=4.0))
+    keys = {t.name: t.api_key for t in tn.tenants}
+    model = model_name or cfg.models[0].name
+
+    state = RouterState(cfg)
+    app = make_router_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()  # on_startup spawns hosts + workers + autopilot
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    base = f"http://127.0.0.1:{port}"
+    url = f"{base}/v1/models/{model}:predict"
+    payload = synthetic_image_npy(edge=cfg.model(model).wire_size)
+    ctype = "application/x-npy"
+
+    def _bucket() -> dict:
+        return {"n_200": 0, "n_429": 0, "n_503": 0, "n_other": 0,
+                "transport_errors": 0, "reasons": {}}
+
+    hostile = _bucket()
+    victim = _bucket()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    try:
+        # Reference request (as the victim — anonymous is 401 now): the
+        # fleet must serve before the clock starts.
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, data=payload, headers={
+                    "Content-Type": ctype,
+                    "X-Api-Key": keys["victim"]}) as r:
+                body = await r.read()
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"reference request failed: {r.status} {body[:200]}")
+        await asyncio.sleep(warmup_s)
+        t_load0 = time.monotonic()
+        tasks = [
+            loop.create_task(_tenant_load(
+                url, payload, ctype, keys["hostile"], stop, hostile,
+                clients=max(4, concurrency))),
+            loop.create_task(_tenant_load(
+                url, payload, ctype, keys["victim"], stop, victim,
+                clients=max(2, concurrency // 4), think_s=0.05)),
+        ]
+        await asyncio.sleep(duration_s)
+        stop.set()
+        await asyncio.gather(*tasks)
+
+        ap_desc = state.autopilot.describe() if state.autopilot else {}
+        decisions = ap_desc.get("decisions", [])
+        # Controller reaction time, measured from load start (audit/decision
+        # timestamps are wall-clock; so is this conversion).
+        wall_load0 = time.time() - (time.monotonic() - t_load0)
+        first_action_s = round(decisions[0]["ts"] - wall_load0, 2) \
+            if decisions else None
+        usage = state.tenants.usage() if state.tenants else {}
+        tenant_slo = state.tenant_slo.alerts() \
+            if state.tenant_slo is not None else {}
+        scale_state = state.supervisor.scale_state() \
+            if hasattr(state.supervisor, "scale_state") else []
+        # Audit completeness is asserted against the LIVE endpoint: every
+        # controller decision must be readable from GET /debug/audit.
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/debug/audit") as r:
+                audit_body = await r.json() if r.status == 200 else {}
+            async with s.get(f"{base}/debug/autopilot") as r:
+                ap_http_status = r.status
+            async with s.get(f"{base}/tenants") as r:
+                tenants_http_status = r.status
+        audit_recs = [rec for rec in audit_body.get("audit", [])
+                      if str(rec.get("verb", "")).startswith("autopilot:")]
+    finally:
+        await runner.cleanup()  # on_cleanup -> state.stop() -> fleet drain
+
+    kinds: dict[str, int] = {}
+    for d in decisions:
+        kinds[d["kind"]] = kinds.get(d["kind"], 0) + 1
+    v_total = (victim["n_200"] + victim["n_429"] + victim["n_503"]
+               + victim["n_other"] + victim["transport_errors"])
+    return {
+        "drill": "autopilot",
+        "model": model,
+        "duration_s": duration_s,
+        # The CLI's --min-availability gates the VICTIM: the hostile
+        # tenant's 429s are the contract working, not an outage.
+        "availability": round(victim["n_200"] / v_total, 5) if v_total
+        else 0.0,
+        "tenants": {"hostile": hostile, "victim": victim},
+        "autopilot": {
+            "ticks": ap_desc.get("ticks", 0),
+            "actions_total": ap_desc.get("actions_total", 0),
+            "errors_total": ap_desc.get("errors_total", 0),
+            "rollbacks_total": ap_desc.get("policy", {}).get(
+                "rollbacks_total", 0),
+            "action_kinds": kinds,
+            "first_action_s": first_action_s,
+            "decisions": decisions,
+            "http_status": ap_http_status,
+        },
+        "audit": {
+            "autopilot_records": len(audit_recs),
+            "decisions_total": len(decisions),
+            "complete": len(audit_recs) >= min(
+                len(decisions), cfg.events.audit_capacity),
+        },
+        "tenant_slo": tenant_slo,
+        "tenants_endpoint_status": tenants_http_status,
+        "usage": usage,
+        "scale_state": scale_state,
+    }

@@ -70,7 +70,7 @@ class _AgentSlot:
     """One worker slot inside the host agent."""
 
     __slots__ = ("wid", "cfg", "proc", "conn", "port", "pid",
-                 "fails", "next_at")
+                 "fails", "next_at", "stopping", "stop_at")
 
     def __init__(self, wid: int, cfg) -> None:
         self.wid = wid
@@ -81,6 +81,10 @@ class _AgentSlot:
         self.pid = 0
         self.fails = 0
         self.next_at = 0.0  # monotonic respawn ETA while down
+        # Scale-down drain in progress (ISSUE 16): the slot was told to
+        # stop on purpose — its exit is NOT a death.
+        self.stopping = False
+        self.stop_at = 0.0  # monotonic SIGKILL deadline while stopping
 
 
 def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
@@ -118,15 +122,20 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
 
     name = host_name(host_id)
     slots = [_AgentSlot(wid, cfg) for wid, cfg in zip(wids, wcfgs)]
+    # Active slot count (ISSUE 16 autopilot scaling): slots at index >=
+    # active stay cold until a "scale" op raises it — capacity held in
+    # reserve at zero cost.
+    active = max(1, min(len(slots), int(opts.get("active", len(slots)))))
 
     def _spawn(slot: _AgentSlot) -> None:
         slot.proc, slot.conn, slot.port, slot.pid = spawn_worker_blocking(
             slot.cfg, slot.wid, opts["spawn_timeout_s"])
         slot.fails = 0
         slot.next_at = 0.0
+        slot.stopping = False
 
     try:
-        for slot in slots:
+        for slot in slots[:active]:
             _spawn(slot)
     except Exception as e:  # noqa: BLE001 — report any boot death upward
         for slot in slots:
@@ -140,9 +149,9 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
         raise
 
     conn.send({"op": "ready", "host": host_id, "pgid": os.getpgrp(),
-               "pid": os.getpid(),
+               "pid": os.getpid(), "active": active,
                "workers": [{"wid": s.wid, "port": s.port, "pid": s.pid}
-                           for s in slots]})
+                           for s in slots[:active]]})
 
     def _send(msg: dict) -> bool:
         try:
@@ -154,10 +163,8 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
     router_gone = False
     while not stop_flag["stop"] and not router_gone:
         now = time.monotonic()
-        for slot in slots:
+        for idx, slot in enumerate(slots):
             if slot.proc is not None and not slot.proc.is_alive():
-                # Worker died: a HOST-local failure. Reap, tell the router
-                # (it stops routing here instantly), schedule the respawn.
                 code = slot.proc.exitcode
                 slot.proc.join(0)
                 slot.proc = None
@@ -167,6 +174,16 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
                     except OSError:
                         pass
                     slot.conn = None
+                if slot.stopping:
+                    # Scale-down drain finished: an intentional exit, not
+                    # a death — no postmortem, no respawn clock.
+                    slot.stopping = False
+                    router_gone |= not _send(
+                        {"op": "worker_scaled_down", "wid": slot.wid,
+                         "exitcode": code})
+                    continue
+                # Worker died: a HOST-local failure. Reap, tell the router
+                # (it stops routing here instantly), schedule the respawn.
                 delay = min(opts["respawn_max_s"],
                             opts["respawn_initial_s"]
                             * opts["respawn_multiplier"] ** slot.fails)
@@ -182,7 +199,11 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
                      "stderr_tail": read_tail(ecfg.stderr_path or None,
                                               ecfg.stderr_tail_bytes),
                      "snapshot": read_snapshot(ecfg.snapshot_path or None)})
-            elif slot.proc is None and now >= slot.next_at:
+            elif slot.stopping and slot.proc is not None \
+                    and now >= slot.stop_at:
+                slot.proc.kill()  # drain budget spent: finish the scale-down
+            elif slot.proc is None and not slot.stopping and idx < active \
+                    and now >= slot.next_at:
                 try:
                     _spawn(slot)
                 except Exception:  # noqa: BLE001 — boot failed, back off
@@ -198,8 +219,28 @@ def host_main(host_id: int, wids: list[int], wcfgs: list[ServerConfig],
         try:
             if conn.poll(0.2):
                 msg = conn.recv()
-                if msg.get("op") == "stop":
+                op = msg.get("op")
+                if op == "stop":
                     break
+                if op == "scale":
+                    # Adjust the active slot count live: surplus slots
+                    # drain (SIGTERM, bounded, then SIGKILL above);
+                    # re-activated slots ride the normal respawn branch.
+                    active = max(1, min(len(slots), int(msg["active"])))
+                    now = time.monotonic()
+                    for idx, slot in enumerate(slots):
+                        if idx >= active and slot.proc is not None \
+                                and not slot.stopping:
+                            slot.proc.terminate()
+                            slot.stopping = True
+                            slot.stop_at = now + opts["drain_timeout_s"]
+                        elif idx >= active and slot.proc is None:
+                            router_gone |= not _send(
+                                {"op": "worker_scaled_down",
+                                 "wid": slot.wid, "exitcode": None})
+                        elif idx < active and slot.proc is None \
+                                and not slot.stopping:
+                            slot.next_at = 0.0  # activate next loop pass
         except (EOFError, OSError):
             router_gone = True
 
@@ -307,6 +348,13 @@ class HostSupervisor:
         self._fails = [0] * self.n_hosts
         self._next_up_at = [0.0] * self.n_hosts
         self._respawning: set[int] = set()
+        # Autopilot scaling (ISSUE 16): per-host ACTIVE slot target (a
+        # respawned host resumes its scaled level) and the wids currently
+        # scaled out on purpose — intentionally-down capacity that must
+        # not read as a failure domain (down_domains) or a death.
+        self._active = [cfg.router.active_workers or self.per_host
+                        ] * self.n_hosts
+        self._scaled_down: set[int] = set()
         self._bg: set[asyncio.Task] = set()
         self._health_task: asyncio.Task | None = None
         self._session = None
@@ -366,6 +414,7 @@ class HostSupervisor:
             "respawn_max_s": self.rcfg.respawn_max_s,
             "respawn_multiplier": self.rcfg.respawn_multiplier,
             "drain_timeout_s": self.cfg.drain_timeout_s,
+            "active": self._active[hid],
         }
         if self.cfg.events.enabled:
             # Agent stderr capture (ISSUE 15): per-host file beside the
@@ -429,6 +478,11 @@ class HostSupervisor:
             self._refs[wid] = ref
             self._g_worker_up[wid].set(1.0)
             self._g_worker_inflight[wid].set(0.0)
+        for wid in self._host_wids(h.hid):
+            # Slots the agent booted cold (active < per_host) are scaled
+            # down, not dead.
+            if wid not in h.workers:
+                self._scaled_down.add(wid)
         t = asyncio.get_running_loop().create_task(self._pipe_loop(h))
         self._bg.add(t)
         t.add_done_callback(self._bg.discard)
@@ -491,6 +545,8 @@ class HostSupervisor:
             elif op == "worker_up":
                 self._on_worker_up(h, int(msg["wid"]), int(msg["port"]),
                                    int(msg["pid"]))
+            elif op == "worker_scaled_down":
+                self._on_worker_scaled_down(h, int(msg["wid"]))
 
     def _on_worker_down(self, h: HostHandle, wid: int, msg: dict) -> None:
         log.warning("%s: worker %d died (exit %s); agent respawning in "
@@ -520,10 +576,64 @@ class HostSupervisor:
         ref = WorkerRef(wid, h.hid, port, pid, self.cfg.worker.host)
         h.workers[wid] = ref
         self._refs[wid] = ref
+        self._scaled_down.discard(wid)
         self._c_worker_respawns[wid].inc()
         self._g_worker_up[wid].set(1.0)
         log.info("%s: worker %d respawned (pid %d, port %d)",
                  host_name(h.hid), wid, pid, port)
+
+    def _on_worker_scaled_down(self, h: HostHandle, wid: int) -> None:
+        """A scale-down drain completed: intentionally-released capacity,
+        not a death — no deaths_total, no postmortem."""
+        ref = h.workers.get(wid)
+        if ref is not None:
+            ref.up = False
+            ref.healthy = False
+        self._scaled_down.add(wid)
+        self._g_worker_up[wid].set(0.0)
+        self._g_worker_inflight[wid].set(0.0)
+        log.info("%s: worker %d scaled down", host_name(h.hid), wid)
+
+    # -- scaling (the autopilot's actuator) -----------------------------------
+    def active_slots(self, hid: int) -> int:
+        return self._active[hid]
+
+    def scale_domain(self, hid: int, active: int) -> dict:
+        """Set one host domain's active worker-slot target. Raises
+        ValueError on a bad target, RuntimeError when the host is down
+        (its respawn will honor the previous target)."""
+        if not 0 <= hid < self.n_hosts:
+            raise ValueError(f"no host domain {hid} (hosts: {self.n_hosts})")
+        if not 1 <= active <= self.per_host:
+            raise ValueError(
+                f"active must be in [1, {self.per_host}], got {active}")
+        h = self.hosts[hid]
+        if h is None or not h.proc.is_alive():
+            raise RuntimeError(f"{host_name(hid)} is down")
+        before = self._active[hid]
+        self._active[hid] = active
+        h.conn.send({"op": "scale", "active": active})
+        return {"host": hid, "active_before": before, "active": active,
+                "max_slots": self.per_host}
+
+    def scale_state(self) -> list[dict]:
+        """Per-domain scaling signal for the autopilot collector: live
+        state, active/max slots, healthy count, and summed in-flight."""
+        out = []
+        for hid in range(self.n_hosts):
+            h = self.hosts[hid]
+            up = h is not None and h.proc.is_alive()
+            healthy = inflight = 0
+            if up:
+                for ref in h.workers.values():
+                    if ref.up and ref.healthy:
+                        healthy += 1
+                        inflight += ref.inflight
+            out.append({"host": hid, "up": up,
+                        "active": self._active[hid],
+                        "max_slots": self.per_host,
+                        "healthy": healthy, "inflight": inflight})
+        return out
 
     # -- liveness / health ---------------------------------------------------
     def sweep(self) -> int:
@@ -753,8 +863,11 @@ class HostSupervisor:
         for h in self.hosts:
             if h is None:
                 continue
+            # Scaled-down slots are intentionally cold capacity, not a
+            # recovering failure domain — they never block a reload.
             out.extend(f"{host_name(h.hid)}:worker{r.wid}"
-                       for r in h.workers.values() if not r.up)
+                       for r in h.workers.values()
+                       if not r.up and r.wid not in self._scaled_down)
         return out
 
     def pick(self, exclude: set[int] = frozenset(),
@@ -820,7 +933,9 @@ class HostSupervisor:
             for wid in self._host_wids(hid):
                 ref = h.workers.get(wid)
                 if ref is None or not ref.up:
-                    row = {"worker": wid, "host": hid, "state": "down"}
+                    row = {"worker": wid, "host": hid,
+                           "state": "scaled_down"
+                           if wid in self._scaled_down else "down"}
                 else:
                     row = {
                         "worker": wid, "host": hid,
@@ -837,6 +952,7 @@ class HostSupervisor:
                 "state": "tripped" if self.host_tripped(hid) else "up",
                 "pgid": h.pgid, "pid": h.pid,
                 "uptime_s": round(now - h.started_at, 1),
+                "active_slots": self._active[hid],
                 "respawns_total": self._c_host_respawns[hid].value,
                 "workers": rows,
             })

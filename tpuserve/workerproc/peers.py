@@ -53,6 +53,13 @@ log = logging.getLogger("tpuserve.workerproc")
 
 _VNODES = 64
 
+# Tenant identity crosses the router tier as one header (ISSUE 16): the
+# ingress router resolves the client's X-Api-Key ONCE and forwards the
+# resolved tenant name on cache-shard hops, so the owning router charges
+# the right cache partition without re-authenticating. The peer listener
+# is loopback-only — the header is unforgeable from outside.
+TENANT_HEADER = "X-Tenant"
+
 
 def _point(data: str) -> int:
     return int.from_bytes(

@@ -59,10 +59,13 @@ from aiohttp import web
 
 from tpuserve.analysis import witness
 from tpuserve.cache import ModelCache
-from tpuserve.config import ServerConfig
+from tpuserve.config import ServerConfig, SloConfig
 from tpuserve.faults import CircuitBreaker, Watchdog
 from tpuserve.obs import (FlightRecorder, Metrics, TraceContext,
                           exposition_content_type, spans_to_chrome)
+from tpuserve.scheduler.autopilot import (Action, AutopilotLoop,
+                                          DomainSignal, ModelSignal, Signals)
+from tpuserve.scheduler.tenants import TenantLedger
 from tpuserve.server import _err, _requested_timeout_ms, configure_logging
 from tpuserve.telemetry import (AuditLog, EventLog, MetricSampler,
                                 PostmortemLog, SloEngine, TimeSeriesStore,
@@ -70,6 +73,7 @@ from tpuserve.telemetry import (AuditLog, EventLog, MetricSampler,
 from tpuserve.telemetry import events as events_mod
 from tpuserve.workerproc.hosts import HostSupervisor, host_name
 from tpuserve.workerproc.peers import (
+    TENANT_HEADER,
     HashRing,
     PassiveWorkerView,
     PeerRouterSupervisor,
@@ -254,6 +258,23 @@ class RouterState:
         self._inflight = 0
         self.serving_addresses: list = []
         self._session: aiohttp.ClientSession | None = None
+        # Tenant containment (ISSUE 16): resolve X-Api-Key once at ingress,
+        # admit against the weighted device-seconds ledger, charge at
+        # completion. EVERY router process fronts clients (SO_REUSEPORT),
+        # so every router owns a ledger — enforcement is per-process, and
+        # a tenant's effective quota is (configured quota x routers); set
+        # per-tenant budgets with the router count in mind
+        # (docs/OPERATIONS.md "Tenant containment").
+        self.tenants: TenantLedger | None = None
+        self.tenant_slo: SloEngine | None = None
+        if cfg.tenants.enabled:
+            self.tenants = TenantLedger(cfg.tenants, self.metrics)
+            self.tenants.saturated_fn = self._fleet_saturated
+        # Models the autopilot has engaged shed-on-burn for at the ROUTER
+        # front door: batch-priority work for these models sheds before it
+        # costs a relay. The primary's autopilot owns membership; peers
+        # adopt it from /peer/state so the whole tier sheds together.
+        self.burn_shed: set[str] = set()
         # Telemetry plane, router tier (ISSUE 14): history over the
         # router's own registry plus the SLO engine evaluated over
         # router_latency_ms{model=} — the CLIENT-observed latency, queue +
@@ -271,8 +292,26 @@ class RouterState:
             self.slo = SloEngine(
                 self.metrics, self.store, tcfg.burn_windows_s,
                 metric_fmt="router_latency_ms{{model={name}}}")
+            hooks = [self.slo.tick]
+            if self.tenants is not None and cfg.tenants.slo_latency_ms > 0:
+                # Per-tenant burn gauges (ISSUE 16 satellite): the same
+                # burn-rate machinery evaluated over tenant_latency_ms —
+                # one shared objective from [tenants], labeled tenant= so
+                # the drill (and an operator) can watch a victim tenant's
+                # budget while a neighbor floods.
+                self.tenant_slo = SloEngine(
+                    self.metrics, self.store, tcfg.burn_windows_s,
+                    metric_fmt="tenant_latency_ms{{tenant={name}}}",
+                    label="tenant")
+                tenant_slo_cfg = SloConfig(
+                    latency_ms=cfg.tenants.slo_latency_ms,
+                    availability=cfg.tenants.slo_availability,
+                    burn_alert=cfg.tenants.slo_burn_alert)
+                for tname in self.tenants.names():
+                    self.tenant_slo.register(tname, tenant_slo_cfg)
+                hooks.append(self.tenant_slo.tick)
             self.sampler = MetricSampler(self.store, tcfg.sample_interval_s,
-                                         hooks=[self.slo.tick])
+                                         hooks=hooks)
             for mcfg in cfg.models:
                 self.slo.register(mcfg.name, mcfg.slo)
         self.fleet_scrapes = self.metrics.counter("fleet_scrapes_total")
@@ -294,6 +333,25 @@ class RouterState:
                 self.caches[name] = ModelCache(
                     name, cfg.cache, self.metrics,
                     version_fn=functools.partial(self.generations.get, name, 0))
+        if self.tenants is not None:
+            # Tenant-partitioned cache capacity (ISSUE 16): each tenant's
+            # weighted share bounds how many entries its misses may pin,
+            # so a flooding tenant churns its OWN share first. Hits stay
+            # content-addressed across tenants — identical bytes are
+            # identical answers, not a leak.
+            weights = self.tenants.weights()
+            for c in self.caches.values():
+                c.set_tenant_weights(weights)
+        # Self-healing controller (ISSUE 16 tentpole): the reconcile loop
+        # runs on the PRIMARY only — it owns the supervisors (the scale
+        # actuator) and the audit trail, the same serialization admin
+        # verbs already follow. Peers see its effects through /peer/state
+        # (burn_shed) and the supervisor topology.
+        self.autopilot: AutopilotLoop | None = None
+        if self.is_primary and cfg.autopilot.enabled:
+            self.autopilot = AutopilotLoop(
+                cfg.autopilot, self._collect_signals, self._actuate,
+                audit=self.audit, metrics=self.metrics)
 
     # -- lifecycle -----------------------------------------------------------
     async def start(self) -> None:
@@ -329,6 +387,14 @@ class RouterState:
             self.watchdog.register("_router", "router", self.peer_sup.sweep)
             self._rebuild_ring()
         self.watchdog.start()
+        if self.autopilot is not None:
+            self.autopilot.start()
+            log.info("autopilot engaged (interval %.2fs, hysteresis %d "
+                     "ticks, budget %d/%gs)",
+                     self.cfg.autopilot.interval_s,
+                     self.cfg.autopilot.hysteresis_ticks,
+                     self.cfg.autopilot.max_actions_per_window,
+                     self.cfg.autopilot.window_s)
 
     async def _start_peer_listener(self) -> None:
         """Bind this router's loopback control plane: /peer/state topology,
@@ -369,6 +435,11 @@ class RouterState:
                 cache = self.caches.get(name)
                 if cache is not None:
                     cache.clear()
+        # Adopt the primary autopilot's shed-on-burn set: the whole
+        # router tier sheds together (within one peer_sync_interval_s).
+        if "burn_shed" in data:
+            self.burn_shed = {str(n) for n in (data["burn_shed"] or [])
+                              if str(n) in self.handles}
 
     def peer_state(self) -> dict:
         """The /peer/state body a peer syncs from (primary's authority)."""
@@ -383,7 +454,73 @@ class RouterState:
             ring = [{"router": self.router_id, "peer_url": self.peer_url}]
         return {"ring": ring, "workers": workers,
                 "generations": dict(self.generations),
-                "draining": self.draining}
+                "draining": self.draining,
+                "burn_shed": sorted(self.burn_shed)}
+
+    # -- autopilot (ISSUE 16) -------------------------------------------------
+    def _fleet_saturated(self) -> bool:
+        """The tenant ledger's fair-share gate: is the fleet queueing?
+        More in-flight relays than healthy workers means every worker has
+        work and new arrivals wait — the regime where a tenant over its
+        weighted share must yield to its neighbors."""
+        healthy = len(self.supervisor.healthy_workers())
+        return healthy == 0 or self._inflight >= healthy
+
+    def _collect_signals(self) -> Signals:
+        """One reconcile tick's input (primary only): per-domain queue
+        pressure from the supervisor, per-model burn state from the SLO
+        engine, the shed set the controller itself maintains."""
+        domains = []
+        scale_state = getattr(self.supervisor, "scale_state", None)
+        if scale_state is not None:
+            for row in scale_state():
+                denom = max(1, min(row["active"], row["healthy"]))
+                domains.append(DomainSignal(
+                    hid=row["host"], up=row["up"], active=row["active"],
+                    max_slots=row["max_slots"], healthy=row["healthy"],
+                    pressure=(row["inflight"] / denom if row["up"]
+                              else 0.0)))
+        models = [
+            ModelSignal(
+                name=name,
+                burn_state=(self.slo.state_of(name)
+                            if self.slo is not None else "ok"),
+                shed_engaged=name in self.burn_shed)
+            for name in self.handles]
+        return Signals(now=time.monotonic(), domains=domains, models=models)
+
+    async def _actuate(self, action: Action) -> str:
+        """Turn one controller decision into the SAME operation an
+        operator's admin verb performs. Raising is fine — the loop audits
+        the failure as the action's outcome."""
+        kind, target = action.kind, action.target
+        if kind in ("scale_up", "scale_down"):
+            hid = int(target.split(":", 1)[1])
+            sup = self.supervisor
+            if not hasattr(sup, "scale_domain"):
+                return "error: no host domains to scale ([router] hosts = 0)"
+            delta = 1 if kind == "scale_up" else -1
+            out = sup.scale_domain(hid, sup.active_slots(hid) + delta)
+            action.signals["active_after"] = out["active"]
+            return "ok"
+        if kind == "shed_on":
+            self.burn_shed.add(target)
+            return "ok"
+        if kind == "shed_off":
+            self.burn_shed.discard(target)
+            return "ok"
+        if kind in ("warm", "demote"):
+            workers = self.live_workers()
+            if not workers:
+                return "error: no live worker"
+            results = await asyncio.gather(
+                *(self._admin_call(w, "POST",
+                                   f"/admin/models/{target}:{kind}")
+                  for w in workers))
+            bad = [f"worker{wid}:{status}" for wid, status, _ in results
+                   if status != 200]
+            return "ok" if not bad else "error: " + ", ".join(bad)
+        return f"error: unknown action kind {kind!r}"
 
     def begin_drain(self) -> None:
         self.draining = True
@@ -395,6 +532,11 @@ class RouterState:
         every in-flight relay to resolve within the budget."""
         t0 = time.perf_counter()
         await self.watchdog.stop()
+        if self.autopilot is not None:
+            # The controller must not fight the drain (scaling a domain
+            # this shutdown is about to SIGTERM) — same discipline as
+            # stopping the watchdog's respawns above.
+            await self.autopilot.stop()
         if self.sampler is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.sampler.stop)
@@ -413,6 +555,8 @@ class RouterState:
 
     async def stop(self) -> None:
         await self.watchdog.stop()
+        if self.autopilot is not None:
+            await self.autopilot.stop()
         if self.sampler is not None:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.sampler.stop)
@@ -1017,6 +1161,33 @@ async def _predict_relayed(request: web.Request, state: RouterState,
     if state.draining:
         return _err(503, "router draining; retry against another replica",
                     retry_after=state.shed_retry_after(), trace=ctx)
+    # Tenant containment (ISSUE 16): identity, rate, quota, and fair
+    # share are judged HERE — before the body read, before any relay —
+    # so a hostile tenant's flood is refused in microseconds and never
+    # occupies a worker. The resolved tenant (not the key) rides every
+    # downstream hop.
+    tenant: str | None = None
+    if state.tenants is not None:
+        tenant = state.tenants.resolve(request.headers.get("X-Api-Key"))
+        if tenant is None:
+            shed = state.tenants.shed_unknown()
+            return _err(shed.status, shed.message, reason=shed.reason,
+                        trace=ctx)
+        shed = state.tenants.admit(tenant)
+        if shed is not None:
+            return _err(shed.status, shed.message,
+                        retry_after=shed.retry_after, reason=shed.reason,
+                        trace=ctx)
+    # Shed-on-burn (autopilot actuator): while a model is burning its SLO
+    # error budget, batch-priority work sheds at the front door so the
+    # remaining capacity serves interactive traffic — the router-tier
+    # mirror of the fleet scheduler's burn_shed gate.
+    if name in state.burn_shed \
+            and request.headers.get("X-Priority") == "batch":
+        return _err(503, f"model {name!r} is burning its SLO error "
+                         "budget; batch work shed until the alert clears",
+                    retry_after=state.shed_retry_after(),
+                    reason="burn_shed", trace=ctx)
     breaker = state.breakers[name]
     if not breaker.allow():
         now = time.monotonic()
@@ -1061,7 +1232,7 @@ async def _predict_relayed(request: web.Request, state: RouterState,
     state._inflight += 1
     try:
         ans = await _dispatch(state, name, verb, body, ctype, deadline_at,
-                              priority, ctx)
+                              priority, ctx, tenant)
     except NoHealthyWorker as e:
         breaker.record_failure()
         return _err(503, "no healthy worker; capacity respawning",
@@ -1083,15 +1254,22 @@ async def _predict_relayed(request: web.Request, state: RouterState,
     elif ans.status >= 500:
         breaker.record_failure()
     state.note_shed_reason(name, ans)
-    h.latency.observe((time.perf_counter() - t_start) * 1e3,
-                      trace_id=ctx.trace_id)
+    dur_ms = (time.perf_counter() - t_start) * 1e3
+    h.latency.observe(dur_ms, trace_id=ctx.trace_id)
+    if state.tenants is not None and tenant is not None:
+        # Charge the tenant's sliding-window ledger with the wall time the
+        # request occupied the fleet (the device-time proxy the quota and
+        # fair share enforce) and feed its latency series (the per-tenant
+        # SLO burn input).
+        state.tenants.record(tenant, dur_ms / 1e3, latency_ms=dur_ms)
     return ans.to_response()
 
 
 async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
                     ctype: str, deadline_at: float,
                     priority: str | None = None,
-                    ctx: "TraceContext | None" = None) -> _Answer:
+                    ctx: "TraceContext | None" = None,
+                    tenant: str | None = None) -> _Answer:
     """Cache/single-flight front of the relay (router-owned PR-5 layer),
     sharded across the router tier (ISSUE 13).
 
@@ -1115,20 +1293,21 @@ async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
         owner = state.ring.owner(key)
         if owner is not None and owner[0] != state.router_id:
             ans = await _peer_forward(state, owner, name, verb, body, ctype,
-                                      deadline_at, priority, ctx)
+                                      deadline_at, priority, ctx, tenant)
             if ans is not None:
                 return ans
             # Owner unreachable: fall through to the LOCAL cache path —
             # shard locality is lost until the owner respawns, coalescing
             # within this router still works, and the client sees nothing.
     return await _dispatch_local(state, cache, key, name, verb, body, ctype,
-                                 deadline_at, priority, ctx)
+                                 deadline_at, priority, ctx, tenant)
 
 
 async def _peer_forward(state: RouterState, owner: tuple[int, str],
                         name: str, verb: str, body: bytes, ctype: str,
                         deadline_at: float, priority: str | None,
-                        ctx: "TraceContext | None") -> _Answer | None:
+                        ctx: "TraceContext | None",
+                        tenant: str | None = None) -> _Answer | None:
     """Forward one request to the owning router's peer listener. Returns
     its complete answer, or None on a transport failure (counted in
     cache_peer_errors_total — the caller degrades to local-only)."""
@@ -1137,6 +1316,11 @@ async def _peer_forward(state: RouterState, owner: tuple[int, str],
     headers = {"X-Timeout-Ms": f"{max(1.0, remaining * 1e3):.0f}"}
     if priority:
         headers["X-Priority"] = priority
+    if tenant:
+        # The RESOLVED tenant (never the key) crosses the loopback-only
+        # peer listener so the owner's shard partitions by the same
+        # identity the origin admitted (peers.TENANT_HEADER).
+        headers[TENANT_HEADER] = tenant
     if ctype:
         headers["Content-Type"] = ctype
     span_id = None
@@ -1172,7 +1356,8 @@ async def _peer_forward(state: RouterState, owner: tuple[int, str],
 async def _dispatch_local(state: RouterState, cache: ModelCache, key: str,
                           name: str, verb: str, body: bytes, ctype: str,
                           deadline_at: float, priority: str | None = None,
-                          ctx: "TraceContext | None" = None) -> _Answer:
+                          ctx: "TraceContext | None" = None,
+                          tenant: str | None = None) -> _Answer:
     """This router's own cache shard: hit fast path, else single-flight
     into the worker relay (the pre-ISSUE-13 _dispatch body)."""
     entry = cache.get(key)
@@ -1186,7 +1371,7 @@ async def _dispatch_local(state: RouterState, cache: ModelCache, key: str,
     fut = cache.submit_through(
         key, lambda: loop.create_task(
             state.relay_cacheable(name, verb, body, ctype, deadline_at,
-                                  priority, ctx)), ctx=ctx)
+                                  priority, ctx)), ctx=ctx, tenant=tenant)
     # A coalesced waiter still honors ITS deadline: cancelling the waiter
     # never cancels the leader's flight (ModelCache contract).
     remaining = deadline_at - time.perf_counter()
@@ -1346,6 +1531,8 @@ async def handle_stats(request: web.Request) -> web.Response:
         "draining": state.draining,
         "breakers": {n: br.describe() for n, br in state.breakers.items()},
     }
+    if state.burn_shed:
+        out["robustness"]["burn_shed"] = sorted(state.burn_shed)
     if witness.enabled():
         out["robustness"]["lock_witness"] = witness.snapshot()
     out["workers"] = state.supervisor.stats()
@@ -1397,6 +1584,15 @@ async def handle_stats(request: web.Request) -> web.Response:
             out["slo"] = alerts
     if state.caches:
         out["cache"] = {n: c.stats() for n, c in state.caches.items()}
+    # Tenant containment + controller (ISSUE 16): live window usage and
+    # the reconcile loop's counters. Full decision history is one hop
+    # away at /debug/autopilot, the per-tenant view at /tenants.
+    if state.tenants is not None:
+        out["tenants"] = state.tenants.usage()
+    if state.autopilot is not None:
+        ap = state.autopilot.describe()
+        ap.pop("decisions", None)  # keep /stats bounded
+        out["autopilot"] = ap
     return web.json_response(out)
 
 
@@ -1638,6 +1834,93 @@ async def handle_versions(request: web.Request) -> web.Response:
     return web.json_response(body, status=status)
 
 
+async def handle_scale_host(request: web.Request) -> web.Response:
+    """POST /admin/hosts/{hid}:scale?active=N — set one host domain's
+    active worker-slot target (ISSUE 16). The SAME audited verb the
+    autopilot's scale actuator uses, so an operator's manual scale and a
+    controller decision read identically in /debug/audit. Serialized
+    through the primary like every fleet transition."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        events_mod.reject_unknown_query(request.query, {"active"})
+    except ValueError as e:
+        return _err(400, str(e))
+    try:
+        hid = int(request.match_info["hid"])
+        active = int(request.query["active"])
+    except KeyError:
+        return _err(400, "?active=<slots> is required")
+    except ValueError:
+        return _err(400, "host id and active must be integers")
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(
+            state, "POST", f"/peer/admin/hosts/{hid}:scale?active={active}")
+    if not hasattr(state.supervisor, "scale_domain"):
+        return _err(409, "[router] hosts = 0: there are no host domains "
+                         "to scale")
+    t0 = time.perf_counter()
+    try:
+        out = state.supervisor.scale_domain(hid, active)
+    except ValueError as e:
+        return _err(400, str(e))
+    except RuntimeError as e:
+        if state.audit is not None:
+            state.audit.record(
+                "scale", f"host:{hid}", "rejected",
+                duration_ms=(time.perf_counter() - t0) * 1e3,
+                active=active, error=str(e))
+        return _err(409, str(e))
+    if state.audit is not None:
+        state.audit.record(
+            "scale", f"host:{hid}", "ok",
+            duration_ms=(time.perf_counter() - t0) * 1e3, **out)
+    return web.json_response(out)
+
+
+async def handle_autopilot(request: web.Request) -> web.Response:
+    """GET /debug/autopilot — the controller's decision history: every
+    action with its triggering signal values, outcome, and the damping
+    state (open watches, rollbacks, budget deferrals). The loop runs on
+    the primary; peers proxy like the audit trail."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        events_mod.reject_unknown_query(request.query, set())
+    except ValueError as e:
+        return _err(400, str(e))
+    if not state.is_primary:
+        return await _proxy_admin_to_primary(state, "GET",
+                                             "/peer/debug/autopilot")
+    if state.autopilot is None:
+        return _err(409, "[autopilot] is disabled; no controller runs")
+    body = state.autopilot.describe()
+    body["burn_shed"] = sorted(state.burn_shed)
+    return web.json_response(body)
+
+
+async def handle_tenants(request: web.Request) -> web.Response:
+    """GET /tenants — per-tenant containment envelopes + live window
+    usage on THIS router (each router process admits independently; with
+    N routers a tenant's effective budget is N x its configured one).
+    ``?tenant=`` narrows to one tenant's row."""
+    state: RouterState = request.app[ROUTER_KEY]
+    try:
+        events_mod.reject_unknown_query(request.query, {"tenant"})
+    except ValueError as e:
+        return _err(400, str(e))
+    if state.tenants is None:
+        return _err(409, "[tenants] is disabled; no tenant ledger is kept")
+    body = state.tenants.usage()
+    body["router_id"] = state.router_id
+    if state.tenant_slo is not None:
+        body["slo"] = state.tenant_slo.alerts()
+    want = request.query.get("tenant")
+    if want is not None:
+        if want not in body["tenants"]:
+            return _err(404, f"unknown tenant {want!r}")
+        body["tenants"] = {want: body["tenants"][want]}
+    return web.json_response(body)
+
+
 # -- peer control plane (ISSUE 13) -------------------------------------------
 
 async def handle_peer_state(request: web.Request) -> web.Response:
@@ -1687,6 +1970,9 @@ async def handle_peer_relay(request: web.Request, verb: str) -> web.Response:
         return _err(404, f"unknown model {name!r}")
     ctx = TraceContext.from_headers(request.headers, pid=0)
     priority = request.headers.get("X-Priority")
+    # The origin router resolved the API key; the resolved tenant rides
+    # the loopback hop so this shard partitions by the same identity.
+    tenant = request.headers.get(TENANT_HEADER) or None
     t_start = time.perf_counter()
     body = await request.read()
     ctype = request.content_type or ""
@@ -1708,7 +1994,8 @@ async def handle_peer_relay(request: web.Request, verb: str) -> web.Response:
         else:
             key = cache.key_for((verb, ctype, body))
             ans = await _dispatch_local(state, cache, key, name, verb, body,
-                                        ctype, deadline_at, priority, ctx)
+                                        ctype, deadline_at, priority, ctx,
+                                        tenant)
     except NoHealthyWorker as e:
         return _err(503, "no healthy worker; capacity respawning",
                     retry_after=max(1, math.ceil(e.eta_s)), trace=ctx)
@@ -1743,6 +2030,7 @@ def make_peer_app(state: RouterState) -> web.Application:
     app.router.add_post("/peer/admin/{name}:reload", handle_reload)
     app.router.add_post("/peer/admin/{name}:rollback", handle_rollback)
     app.router.add_get("/peer/admin/{name}/versions", handle_versions)
+    app.router.add_post("/peer/admin/hosts/{hid}:scale", handle_scale_host)
     app.router.add_get("/peer/stats", handle_stats)
     app.router.add_get("/peer/healthz", handle_healthz)
     # Telemetry (ISSUE 14): /peer/metrics is what the PRIMARY scrapes for
@@ -1757,6 +2045,9 @@ def make_peer_app(state: RouterState) -> web.Application:
     # every process).
     app.router.add_get("/peer/debug/audit", handle_audit)
     app.router.add_get("/peer/debug/postmortems", handle_postmortems)
+    # Controller plane (ISSUE 16): peers proxy /debug/autopilot here — the
+    # loop runs on the primary, its decision history is the fleet's.
+    app.router.add_get("/peer/debug/autopilot", handle_autopilot)
     return app
 
 
@@ -1782,6 +2073,7 @@ def make_router_app(state: RouterState,
     app.router.add_post("/admin/models/{name}:reload", handle_reload)
     app.router.add_post("/admin/models/{name}:rollback", handle_rollback)
     app.router.add_get("/admin/models/{name}/versions", handle_versions)
+    app.router.add_post("/admin/hosts/{hid}:scale", handle_scale_host)
     app.router.add_get("/workers/{wid}/stats/history", handle_worker_history)
     app.router.add_get("/workers/{wid}/debug/events", handle_worker_events)
     app.router.add_get("/workers/{wid}/{page}", handle_worker_proxy)
@@ -1801,6 +2093,9 @@ def make_router_app(state: RouterState,
     app.router.add_get("/debug/events", handle_events)
     app.router.add_get("/debug/postmortems", handle_postmortems)
     app.router.add_get("/debug/audit", handle_audit)
+    # Self-operating fleet (ISSUE 16): controller history + tenant view.
+    app.router.add_get("/debug/autopilot", handle_autopilot)
+    app.router.add_get("/tenants", handle_tenants)
     app.router.add_get("/", handle_index)
 
     if own_lifecycle:

@@ -61,9 +61,15 @@ def worker_config(cfg: ServerConfig, worker_id: int) -> ServerConfig:
                  if cfg.worker.port_base else 0)
     if cfg.worker.drain_timeout_s > 0:
         wcfg.drain_timeout_s = cfg.worker.drain_timeout_s
-    # Router-owned layers never run in the worker.
+    # Router-owned layers never run in the worker. Tenancy admits at the
+    # tier that fronts clients: the router resolves X-Api-Key once and
+    # relays the tenant as the loopback X-Tenant header — a worker-side
+    # ledger would 401 every relay (no key crosses the hop) and
+    # double-charge the window.
     wcfg.router.enabled = False
     wcfg.cache.enabled = False
+    wcfg.tenants.enabled = False
+    wcfg.autopilot.enabled = False
     # Black box (ISSUE 15, docs/OBSERVABILITY.md "The third pillar"): the
     # supervisor resolves ONE black-box directory for the deployment
     # (stable across respawns — it runs in the supervisor's process) and
@@ -115,6 +121,10 @@ def worker_main(cfg: ServerConfig, worker_id: int, conn) -> None:
     try:
         state = ServerState(cfg)
         state.worker_id = worker_id
+        if state.injector is not None:
+            # Worker-pinned [[faults.rule]] entries (rule.worker >= 0) only
+            # fire in the matching worker process.
+            state.injector.worker_id = worker_id
         if state.events is not None:
             # Events carry the same process-lane vocabulary as spans
             # (0 = router, worker id + 1 behind it) so a stitched trace's
