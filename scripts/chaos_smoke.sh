@@ -53,6 +53,9 @@ scripts/events_smoke.sh
 echo "== worker drill (SIGKILL a worker mid-load, availability >= 99%) =="
 scripts/worker_drill.sh
 
+echo "== stream drill (SIGKILL a worker mid-stream, zero torn streams, byte-audited tokens, availability >= 99%) =="
+scripts/stream_drill.sh
+
 echo "== host drill (killpg an entire host mid-load, survivors >= 99%, sharded-cache router kill) =="
 scripts/host_drill.sh
 

@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# Mid-stream chaos drill (ISSUE 17): a REAL router + 2 worker processes
+# serving a generative model under MIXED streaming + unary load; SIGKILL
+# one worker mid-stream and assert the fail-safe stream semantics hold
+# (docs/ROBUSTNESS.md "Streaming failure semantics"):
+#   1. every stream that STARTED on the dead worker ends in a well-formed
+#      error terminal — zero torn streams (silent truncation is the one
+#      forbidden outcome);
+#   2. zero duplicate or reordered tokens: the first-byte latch means no
+#      post-latch retry/hedge, byte-audited against a seeded reference
+#      (done streams match exactly; error streams are strict prefixes);
+#   3. streams that had NOT started retry transparently (unary
+#      availability >= 99% across the run, kill included);
+#   4. the kill perturbs nothing on the survivor: compile deltas 0;
+#   5. the supervisor respawns the victim within the backoff budget.
+# Runs the real `python -m tpuserve chaos --drill stream_kill` CLI; wired
+# into chaos_smoke.sh and CI next to the worker/host/autopilot drills.
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+# Race-detection pass rides along (docs/ANALYSIS.md): router, supervisor,
+# engine stream channels, and both workers run under witnessed locks.
+export TPUSERVE_LOCK_WITNESS=1
+
+CFG="$(mktemp /tmp/tpuserve_stream_drill.XXXXXX.toml)"
+OUT="$(mktemp /tmp/tpuserve_stream_drill.XXXXXX.json)"
+BB="$(mktemp -d /tmp/tpuserve_stream_drill_bb.XXXXXX)"
+trap 'rm -f "$CFG" "$OUT"; rm -rf "$BB"' EXIT
+
+cat > "$CFG" <<EOF
+decode_threads = 2
+startup_canary = false
+drain_timeout_s = 5.0
+
+[events]
+dir = "$BB"
+snapshot_interval_s = 0.3
+
+[genserve]
+enabled = true
+slots = 4
+stream_queue = 64
+stream_heartbeat_s = 2.0
+stream_drain_s = 3.0
+
+[router]
+enabled = true
+workers = 2
+retry_max = 2
+hedge_ms = 200.0
+health_interval_s = 0.2
+respawn_initial_s = 0.5
+respawn_max_s = 5.0
+stream_idle_timeout_ms = 10000.0
+stream_drain_s = 3.0
+
+[[model]]
+name = "textgen"
+family = "textgen"
+batch_buckets = [1, 2, 4]
+dtype = "float32"
+parallelism = "single"
+request_timeout_ms = 60000.0
+stream_policy = "drop"
+
+[model.slo]
+latency_ms = 5000.0
+first_unit_ms = 2000.0
+
+[model.options]
+layers = 1
+d_model = 64
+heads = 2
+d_ff = 128
+vocab_size = 512
+prompt_len = 16
+max_new_tokens = 32
+EOF
+
+python -m tpuserve chaos --config "$CFG" --drill stream_kill \
+    --duration 14 --warmup 1 --concurrency 12 --kill-after 2 \
+    --respawn-budget 90 --min-availability 0.99 | tee "$OUT"
+
+python - "$OUT" <<'EOF'
+import json, sys
+
+s = json.load(open(sys.argv[1]))
+kill = s["kill"]
+a = s["stream_audit"]
+
+# Gate 3: un-started streams retried transparently — the unary load's
+# availability is the survivors' view of the fleet.
+assert s["availability"] >= 0.99, f"availability {s['availability']}"
+
+# Gate 1: zero silent truncations. Every started stream carries exactly
+# one terminal; the SIGKILL-cut streams must show the router's appended
+# error terminal, never a bare EOF.
+assert a["started"] > 0 and a["done"] > 0, a
+assert a["torn"] == 0, f"torn streams (silent truncation): {a}"
+assert a["error_terminals"] >= 1, \
+    f"the mid-stream SIGKILL should cut at least one stream: {a}"
+assert a["done"] + a["error_terminals"] == a["started"], a
+
+# Gate 2: zero duplicate/reordered tokens, byte-audited vs the seeded
+# reference. The first-byte latch forbids post-latch re-dispatch, so a
+# replayed or doubled token shows up as an order violation, a byte
+# mismatch on a done stream, or a non-prefix on an error stream.
+assert a["order_violations"] == 0, f"duplicate/reordered tokens: {a}"
+assert a["mismatched"] == 0, f"done-stream byte mismatch vs reference: {a}"
+assert a["non_prefix"] == 0, f"error-stream text not a prefix: {a}"
+
+# Gate 4: the kill recompiles nothing on the survivor.
+deltas = s["compile_deltas"]
+assert deltas and all(v == 0 for v in deltas.values()), \
+    f"survivor recompiled under the kill: {deltas}"
+
+# Gate 5: respawn within budget; fleet healthy at the end.
+assert kill.get("respawn_s") is not None, f"no respawn within budget: {kill}"
+assert s["workers"]["healthy"] == 2, s["workers"]
+assert s["workers"]["deaths_total"] == 1, s["workers"]
+
+# The router's own books must agree with the client-side audit.
+r = s["router"]
+assert r["streams_total"] >= a["started"], (r, a)
+term = r["stream_terminated"]
+n_err_rows = sum(v for k, v in term.items()
+                 if "reason=done" not in k)
+assert n_err_rows >= 1, f"router counted no mid-stream terminations: {term}"
+
+# Postmortem evidence (ISSUE 15): the SIGKILL must be diagnosable from
+# the artifact alone.
+pms = [p for p in s.get("postmortems", []) if p.get("signal") == "SIGKILL"]
+assert pms and pms[0]["pid"] == kill["killed_pid"], s.get("postmortems")
+
+print(f"stream drill OK: availability {s['availability']}, "
+      f"{a['started']} streams started ({a['done']} done, "
+      f"{a['error_terminals']} error terminals, 0 torn, 0 reordered, "
+      f"0 byte mismatches), first-token p99 {a['first_token_p99_ms']}ms, "
+      f"gap p99 {a['inter_token_gap_p99_ms']}ms, "
+      f"respawn {kill['respawn_s']}s, compile deltas all 0")
+EOF
+
+echo "stream drill OK"

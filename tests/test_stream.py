@@ -1,0 +1,416 @@
+"""Fail-safe streaming semantics (ISSUE 17): SSE/frame wire goldens, the
+one-terminal contract end-to-end over HTTP with a byte audit against the
+unary result, disconnect-frees-slot, the stream-drain budget, the shared
+``?stream=`` validator, and the torn-stream parser tolerance the
+"stream_stall" / "stream_disconnect" fault kinds exercise in the drill.
+docs/ROBUSTNESS.md "Streaming failure semantics"."""
+
+import asyncio
+import json
+
+import pytest
+
+from tpuserve.bench.loadgen import SseParser
+from tpuserve.config import (FAULT_KINDS, GenserveConfig, ModelConfig,
+                             ServerConfig)
+from tpuserve.frame import StreamFrameReader, encode_stream_event
+from tpuserve.genserve import GenEngine
+from tpuserve.models import build
+from tpuserve.obs import Metrics
+from tpuserve.runtime import build_runtime
+
+TG_OPTS = dict(layers=1, d_model=32, heads=2, d_ff=64, vocab_size=512,
+               prompt_len=16, max_new_tokens=64)
+
+
+def tg_cfg(**over) -> ModelConfig:
+    base = dict(name="tg", family="textgen", batch_buckets=[1, 2, 4],
+                dtype="float32", parallelism="single", max_queue=64,
+                request_timeout_ms=60_000.0, options=dict(TG_OPTS))
+    base.update(over)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def tg_rt():
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    eng = GenEngine(model, rt, Metrics(), GenserveConfig(slots=4))
+    eng.compile()
+    return model, rt
+
+
+def make_engine(tg_rt, metrics=None, slots=4, **gc_over):
+    model, rt = tg_rt
+    m = metrics or Metrics()
+    eng = GenEngine(model, rt, m, GenserveConfig(slots=slots, **gc_over))
+    eng.compile()
+    return eng, m
+
+
+def prompt_item(model, prompt="hello world", seed=0, max_new=8):
+    body = {"prompt": prompt, "seed": seed, "max_new_tokens": max_new}
+    return model.host_decode(json.dumps(body).encode(), "application/json")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def drain_stream(stream, timeout_s=30.0):
+    """Consume a GenStream to its terminal; the one-terminal contract says
+    this always returns (every failure path enqueues a terminal)."""
+    units = []
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while True:
+        budget = deadline - asyncio.get_running_loop().time()
+        assert budget > 0, f"no terminal within {timeout_s}s: {units}"
+        unit = await asyncio.wait_for(stream.get(), budget)
+        units.append(unit)
+        if unit["type"] in ("done", "error"):
+            return units
+
+
+# ---------------------------------------------------------------------------
+# Wire goldens
+# ---------------------------------------------------------------------------
+
+def test_sse_wire_goldens(tg_rt):
+    """The SSE encoding is a wire contract: event name = unit type, data =
+    the unit minus transport-internal keys, blank-line terminated."""
+    model, _ = tg_rt
+    token = model.encode_stream_unit(
+        {"type": "token", "text": "hi", "index": 3})
+    assert token == (b"event: token\n"
+                     b'data: {"text": "hi", "index": 3}\n\n')
+    done = model.encode_stream_unit(
+        {"type": "done", "finish_reason": "stop",
+         "usage": {"completion_tokens": 6}})
+    assert done.startswith(b"event: done\ndata: ")
+    assert done.endswith(b"\n\n")
+    assert json.loads(done.split(b"data: ", 1)[1]) == {
+        "finish_reason": "stop", "usage": {"completion_tokens": 6}}
+    # droppable is transport metadata (slow-consumer policy), never wire.
+    prog = model.encode_stream_unit(
+        {"type": "progress", "step": 2, "droppable": True})
+    assert b"droppable" not in prog
+    assert model.stream_heartbeat() == b": hb\n\n"  # SSE comment frame
+    assert model.stream_content_type() == "text/event-stream"
+
+
+def test_frame_stream_event_roundtrip():
+    """Binary stream events (sd15's wire) survive arbitrary chunk tears:
+    StreamFrameReader reassembles, .pending flags a torn tail."""
+    a = encode_stream_event(json.dumps({"type": "progress",
+                                        "step": 1}).encode())
+    b = encode_stream_event(json.dumps({"type": "done",
+                                        "finish_reason": "stop"}).encode())
+    blob = a + b
+    for cut in range(1, len(blob)):
+        r = StreamFrameReader()
+        events = list(r.feed(blob[:cut])) + list(r.feed(blob[cut:]))
+        payloads = [json.loads(p) for _, p in events
+                    if p is not None]
+        assert {"type": "progress", "step": 1} in payloads
+        assert payloads[-1]["type"] == "done"
+        assert not r.pending  # fully consumed
+    r = StreamFrameReader()
+    list(r.feed(blob[:len(a) + 3]))
+    assert r.pending  # torn mid-frame: the tail is visible, not silent
+
+
+def test_sse_parser_torn_event_tolerance():
+    """A SIGKILL tears an SSE stream mid-event; the router glues its error
+    terminal right after. The parser must never let the torn fragment
+    swallow the terminal — it surfaces as junk instead."""
+    p = SseParser()
+    events = list(p.feed(b'event: token\ndata: {"text": "a", "index": 0}'
+                         b"\n\n"))
+    # torn token event (no blank line) + the router's appended terminal:
+    events += list(p.feed(b'event: token\ndata: {"te'
+                          b'\nevent: error\ndata: {"error": '
+                          b'"upstream_error", "message": "worker died"}'
+                          b"\n\n"))
+    kinds = [e for e, _ in events]
+    assert kinds == ["token", "token", "error"]
+    assert json.loads(events[-1][1])["error"] == "upstream_error"
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(events[1][1])  # the torn fragment is the junk one
+    assert not p.pending
+
+
+# ---------------------------------------------------------------------------
+# Engine: one-terminal contract, disconnect, drain budget
+# ---------------------------------------------------------------------------
+
+def test_stream_happy_path_one_terminal(tg_rt):
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        try:
+            fut, stream = eng.submit_stream(
+                prompt_item(model, "stream me", seed=9, max_new=6))
+            units = await drain_stream(stream)
+            terminal = units[-1]
+            assert terminal["type"] == "done"
+            assert terminal["finish_reason"] in ("stop", "length")
+            assert terminal["usage"]["completion_tokens"] == 6
+            tokens = [u for u in units if u["type"] == "token"]
+            assert [u["index"] for u in tokens] == list(range(len(tokens)))
+            # Byte audit: streamed deltas concatenate to the unary text
+            # (detokenize is append-only; generation is seeded).
+            result = await fut
+            assert "".join(u["text"] for u in tokens) == result["text"]
+            assert sum(1 for u in units
+                       if u["type"] in ("done", "error")) == 1
+        finally:
+            await eng.stop()
+        assert m.counter("gen_streams_total{model=tg}").value == 1
+        assert m.counter(
+            "gen_stream_terminated_total{model=tg,reason=done}").value == 1
+
+    run(go())
+
+
+def test_disconnect_frees_slot_and_ledger_balances(tg_rt):
+    """A client disconnect (cancelled future + closed stream — exactly
+    what the HTTP layer's abandon hook does) must free the slot for
+    fold-in and tick gen_client_disconnects_total; the arena ledger ends
+    balanced."""
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+
+    async def go():
+        await eng.start()
+        try:
+            fut, stream = eng.submit_stream(
+                prompt_item(model, "abandoned", seed=3, max_new=64))
+            first = await asyncio.wait_for(stream.get(), 30.0)
+            assert first["type"] == "token"
+            fut.cancel()
+            stream.close()
+            deadline = asyncio.get_running_loop().time() + 30.0
+            while eng.arena.n_active:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            assert eng.arena.n_free == eng.slots  # ledger balanced
+            assert m.counter(
+                "gen_client_disconnects_total{model=tg}").value == 1
+            assert m.counter(
+                "gen_stream_terminated_total{model=tg,"
+                "reason=disconnect}").value == 1
+        finally:
+            await eng.stop()
+
+    run(go())
+
+
+def test_stream_drain_budget_terminates_stragglers(tg_rt):
+    """Drain gives in-flight streams a bounded budget (stream_drain_s);
+    past it they get the well-formed "drain" error terminal — never a
+    silent truncation, never an unbounded drain."""
+    from tpuserve.faults import FaultInjector
+
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt, stream_drain_s=0.05)
+    # Slow each iteration so the generation provably outlives the 50 ms
+    # stream budget on any host.
+    eng.injector = FaultInjector.single("slow_dispatch", delay_ms=20.0)
+
+    async def go():
+        await eng.start()
+        try:
+            fut, stream = eng.submit_stream(
+                prompt_item(model, "long haul", seed=5, max_new=64))
+            first = await asyncio.wait_for(stream.get(), 30.0)
+            assert first["type"] == "token"
+            loop = asyncio.get_running_loop()
+            ok = await eng.drain(loop.time() + 30.0)
+            assert ok, "drain must converge once stragglers are killed"
+            units = await drain_stream(stream, timeout_s=5.0)
+            terminal = units[-1]
+            assert terminal["type"] == "error"
+            assert terminal["error"] == "drain"
+            assert fut.done()
+            assert m.counter(
+                "gen_stream_terminated_total{model=tg,"
+                "reason=drain}").value == 1
+        finally:
+            await eng.stop()
+
+    run(go())
+
+
+def test_shutdown_terminates_streams(tg_rt):
+    """stop() mid-generation pushes the "shutdown" error terminal. The
+    tiny stream queue guarantees the step loop is still mid-flight
+    (blocked emitting into the full queue) when stop lands — the
+    terminal can't race a natural "done"."""
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt, stream_queue=4)
+
+    async def go():
+        await eng.start()
+        fut, stream = eng.submit_stream(
+            prompt_item(model, "cut off", seed=8, max_new=64))
+        await asyncio.wait_for(stream.get(), 30.0)
+        await eng.stop()
+        units = await drain_stream(stream, timeout_s=5.0)
+        assert units[-1]["type"] == "error"
+        assert units[-1]["error"] == "shutdown"
+        assert m.counter("gen_stream_terminated_total{model=tg,"
+                         "reason=shutdown}").value == 1
+
+    run(go())
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door: SSE end-to-end, validator, injected tears
+# ---------------------------------------------------------------------------
+
+def _gen_server(**over):
+    from tpuserve.server import ServerState
+
+    base = dict(
+        decode_threads=2,
+        genserve=GenserveConfig(enabled=True, slots=4),
+        models=[tg_cfg()])
+    base.update(over)
+    cfg = ServerConfig(**base)
+    state = ServerState(cfg)
+    state.build()
+    return state
+
+
+def test_http_stream_end_to_end_byte_audited():
+    """stream=true over HTTP: the committed response carries the first-
+    byte latch header, exactly one done terminal with finish reason +
+    usage, contiguous token indices, and the concatenated deltas equal
+    the unary result byte-for-byte (the drill's audit anchor)."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.server import make_app
+
+    state = _gen_server()
+    body = json.dumps({"prompt": "stream parity", "seed": 11,
+                       "max_new_tokens": 8})
+    hdr = {"Content-Type": "application/json"}
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            unary = await client.post("/v1/models/tg:generate",
+                                      data=body, headers=hdr)
+            assert unary.status == 200, await unary.text()
+            ref = await unary.json()
+
+            r = await client.post("/v1/models/tg:generate?stream=true",
+                                  data=body, headers=hdr)
+            assert r.status == 200, await r.text()
+            assert r.headers["X-Tpuserve-Stream"] == "1"  # the latch
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            events = list(SseParser().feed(await r.read()))
+            tokens = [json.loads(d) for e, d in events if e == "token"]
+            terminals = [(e, json.loads(d)) for e, d in events
+                         if e in ("done", "error")]
+            assert len(terminals) == 1 and terminals[0][0] == "done"
+            assert terminals[0][1]["finish_reason"] in ("stop", "length")
+            assert terminals[0][1]["usage"]["completion_tokens"] == 8
+            assert [t["index"] for t in tokens] == list(range(len(tokens)))
+            assert "".join(t["text"] for t in tokens) == ref["text"]
+
+            metrics = await (await client.get("/metrics")).text()
+            assert 'gen_streams_total{model="tg"}' in metrics
+            assert 'gen_first_unit_ms' in metrics
+        finally:
+            await client.close()
+
+    run(go())
+
+
+def test_http_junk_stream_flag_rejects():
+    """A typo'd ?stream= must 400 loudly (shared validator — the router
+    imports the same _requested_stream), never silently serve unary."""
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.server import _requested_stream, make_app
+
+    state = _gen_server()
+    body = json.dumps({"prompt": "x", "seed": 1, "max_new_tokens": 2})
+    hdr = {"Content-Type": "application/json"}
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            for junk in ("banana", "yes", "2"):
+                r = await client.post(
+                    f"/v1/models/tg:generate?stream={junk}",
+                    data=body, headers=hdr)
+                assert r.status == 400, (junk, await r.text())
+                assert "stream" in (await r.json())["error"]
+            # stream=false / 0 serve plain unary JSON.
+            r = await client.post("/v1/models/tg:generate?stream=false",
+                                  data=body, headers=hdr)
+            assert r.status == 200
+            assert "X-Tpuserve-Stream" not in r.headers
+            assert (await r.json())["n_tokens"] == 2
+        finally:
+            await client.close()
+
+    # The router relays through this exact validator (single source of
+    # truth for the flag's grammar).
+    from tpuserve.workerproc import router as router_mod
+    assert router_mod._requested_stream is _requested_stream
+
+    run(go())
+
+
+def test_injected_stream_disconnect_is_a_torn_stream():
+    """The "stream_disconnect" fault kind tears a STARTED stream's
+    transport with no terminal — the torn shape clients must error on
+    (and the drill proves the router converts into an error terminal).
+    "stream_stall" is the sibling kind (wedged writer; the router's idle
+    timeout owns it) — both are registered FAULT_KINDS."""
+    assert "stream_stall" in FAULT_KINDS
+    assert "stream_disconnect" in FAULT_KINDS
+
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.faults import FaultInjector
+    from tpuserve.server import make_app
+
+    state = _gen_server()
+    state.injector = FaultInjector.single("stream_disconnect")
+    body = json.dumps({"prompt": "torn", "seed": 2, "max_new_tokens": 8})
+    hdr = {"Content-Type": "application/json"}
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/models/tg:generate?stream=true",
+                                  data=body, headers=hdr)
+            assert r.status == 200  # the stream STARTED (latch committed)
+            try:
+                raw = await r.read()
+            except Exception:
+                raw = b""  # the tear can surface as a transport error
+            events = list(SseParser().feed(raw))
+            assert not any(e in ("done", "error") for e, _ in events), \
+                f"torn stream must carry NO terminal: {events}"
+            # The abandon hook frees the slot engine-side.
+            deadline = asyncio.get_running_loop().time() + 30.0
+            eng = state.engines["tg"]
+            while eng.arena.n_active:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.01)
+            assert eng.arena.n_free == eng.slots
+        finally:
+            await client.close()
+
+    run(go())

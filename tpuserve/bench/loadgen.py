@@ -79,6 +79,215 @@ class LoadResult:
         return out
 
 
+@dataclass
+class StreamLoadResult:
+    """Closed-loop STREAMING load (ISSUE 17): per-stream first-token
+    latency, inter-token gaps, and exact tokens/s measured from token
+    EVENT arrival timestamps — not from request completions, which for a
+    stream only say when the last byte landed."""
+
+    mode: str = "stream-closed"
+    n_ok: int = 0       # terminal "done" inside the window
+    n_err: int = 0      # plain status, "error" terminal, or torn stream
+    n_late: int = 0
+    duration_s: float = 0.0
+    distinct_payloads: int = 0
+    tokens: int = 0     # token events that ARRIVED inside the window
+    torn: int = 0       # streams ending with no terminal (must be 0)
+    terminals: dict = field(default_factory=dict)
+    first_token_ms: list[float] = field(default_factory=list)
+    gap_ms: list[float] = field(default_factory=list)
+
+    def summary(self) -> dict:
+        out = {
+            "mode": self.mode,
+            "n_ok": self.n_ok,
+            "n_err": self.n_err,
+            "n_late": self.n_late,
+            "duration_s": round(self.duration_s, 3),
+            "streams_per_s": round(self.n_ok / self.duration_s, 2)
+            if self.duration_s > 0 else 0.0,
+            "tokens_per_s": round(self.tokens / self.duration_s, 1)
+            if self.duration_s > 0 else 0.0,
+            "first_token_p50_ms": round(
+                percentile(self.first_token_ms, 0.5), 3),
+            "first_token_p99_ms": round(
+                percentile(self.first_token_ms, 0.99), 3),
+            "inter_token_gap_p99_ms": round(percentile(self.gap_ms, 0.99), 3),
+            "terminals": dict(self.terminals),
+            "torn_streams": self.torn,
+        }
+        if self.distinct_payloads:
+            out["distinct_payloads"] = self.distinct_payloads
+        return out
+
+
+class SseParser:
+    """Incremental ``text/event-stream`` parser.
+
+    feed() returns complete ``(event, data_text)`` pairs; comment lines
+    (the server's ``: hb`` heartbeats) are dropped. Deliberately tolerant
+    of a TORN event glued to a later complete one (a worker SIGKILLed
+    mid-write, then the router's appended error terminal): each ``event:``
+    line starts a fresh pair, so the partial pair surfaces as undecodable
+    data for the caller to count — never as a swallowed terminal."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    @property
+    def pending(self) -> int:
+        """Bytes of an incomplete event still buffered (torn-tail audit)."""
+        return len(self._buf)
+
+    def feed(self, chunk: bytes) -> list[tuple[str, str]]:
+        self._buf += chunk
+        out: list[tuple[str, str]] = []
+        while b"\n\n" in self._buf:
+            block, self._buf = self._buf.split(b"\n\n", 1)
+            event: str | None = None
+            data: list[bytes] = []
+            for line in block.split(b"\n"):
+                if line.startswith(b":"):
+                    continue  # heartbeat / comment
+                if line.startswith(b"event:"):
+                    if event is not None:
+                        out.append((event, b"\n".join(data).decode(
+                            "utf-8", "replace")))
+                        data = []
+                    event = line[6:].strip().decode("utf-8", "replace")
+                elif line.startswith(b"data:"):
+                    data.append(line[5:].strip())
+            if event is not None:
+                out.append((event,
+                            b"\n".join(data).decode("utf-8", "replace")))
+        return out
+
+
+async def stream_generate(session, url: str, data: bytes, headers: dict,
+                          total_timeout_s: float = 120.0) -> dict:
+    """POST one ``?stream=true`` generation and consume the SSE stream to
+    EOF. Returns the full per-stream record the drill's byte-audit needs:
+    concatenated token text, token indices and arrival times
+    (perf_counter), the terminal ("done"/"error"/None), and ``torn`` —
+    True when the stream ended with NO terminal event, which is exactly
+    the silent truncation the streaming contract forbids."""
+    import aiohttp
+
+    rec: dict = {"status": None, "terminal": None, "finish_reason": None,
+                 "error": None, "usage": None, "text": "", "indices": [],
+                 "token_times": [], "junk": 0, "torn": False,
+                 "first_token_ms": None}
+    sep = "&" if "?" in url else "?"
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+                f"{url}{sep}stream=true", data=data, headers=headers,
+                timeout=aiohttp.ClientTimeout(total=total_timeout_s)) as r:
+            rec["status"] = r.status
+            if r.status != 200 \
+                    or r.headers.get("X-Tpuserve-Stream") != "1":
+                await r.read()  # plain (pre-first-unit) answer: no stream
+                return rec
+            parser = SseParser()
+            async for chunk in r.content.iter_any():
+                for event, text in parser.feed(chunk):
+                    try:
+                        obj = json.loads(text) if text else {}
+                    except ValueError:
+                        rec["junk"] += 1  # torn event (worker died mid-write)
+                        continue
+                    if event == "token":
+                        now = time.perf_counter()
+                        if rec["first_token_ms"] is None:
+                            rec["first_token_ms"] = (now - t0) * 1e3
+                        rec["token_times"].append(now)
+                        rec["text"] += obj.get("text", "")
+                        rec["indices"].append(obj.get("index"))
+                    elif event == "done":
+                        rec["terminal"] = "done"
+                        rec["finish_reason"] = obj.get("finish_reason")
+                        rec["usage"] = obj.get("usage")
+                    elif event == "error":
+                        rec["terminal"] = "error"
+                        rec["error"] = obj.get("error")
+            if rec["terminal"] is None:
+                rec["torn"] = True  # EOF, no terminal: silent truncation
+            rec["junk"] += 1 if parser.pending else 0
+    except asyncio.CancelledError:
+        raise
+    except Exception:  # noqa: BLE001 — transport failure mid-stream
+        if rec["status"] == 200:
+            rec["torn"] = rec["terminal"] is None
+        elif rec["status"] is None:
+            rec["status"] = -1  # connect-level failure, never admitted
+    return rec
+
+
+async def run_stream_load(
+    url: str,
+    payload: "bytes | list[bytes]",
+    content_type: str,
+    duration_s: float = 10.0,
+    concurrency: int = 8,
+    warmup_s: float = 2.0,
+) -> StreamLoadResult:
+    """Closed-loop streaming mode (``bench --stream``): ``concurrency``
+    workers each keep one STREAM in flight, parsing token events as they
+    arrive. First-token latency and inter-token gaps come from event
+    timestamps; tokens/s counts token arrivals inside the window — the
+    exact generation rate, not an average smeared over request lifetimes."""
+    import aiohttp
+
+    pool = payload if isinstance(payload, (list, tuple)) else None
+    result = StreamLoadResult(distinct_payloads=len(pool) if pool else 0)
+    headers = {"Content-Type": content_type}
+    now = time.perf_counter()
+    record_from = now + warmup_s
+    stop_at = now + warmup_s + duration_s
+    cursor = 0
+
+    async def worker(session) -> None:
+        nonlocal cursor
+        while time.perf_counter() < stop_at:
+            if pool is not None:
+                data = pool[cursor % len(pool)]
+                cursor += 1
+            else:
+                data = payload
+            rec = await stream_generate(session, url, data, headers)
+            # Token arrivals count toward tokens/s regardless of how the
+            # stream ended — delivered tokens are delivered work.
+            result.tokens += sum(1 for t in rec["token_times"]
+                                 if record_from <= t < stop_at)
+            t1 = time.perf_counter()
+            if t1 < record_from:
+                continue
+            if t1 >= stop_at:
+                result.n_late += 1
+                continue
+            term = rec["terminal"] or ("torn" if rec["torn"] else "none")
+            result.terminals[term] = result.terminals.get(term, 0) + 1
+            if rec["torn"]:
+                result.torn += 1
+            if rec["terminal"] == "done":
+                result.n_ok += 1
+                if rec["first_token_ms"] is not None:
+                    result.first_token_ms.append(rec["first_token_ms"])
+                times = rec["token_times"]
+                result.gap_ms.extend(
+                    (b - a) * 1e3 for a, b in zip(times, times[1:]))
+            else:
+                result.n_err += 1
+
+    conn = aiohttp.TCPConnector(limit=concurrency * 2)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        await asyncio.gather(*(asyncio.ensure_future(worker(session))
+                               for _ in range(concurrency)))
+    result.duration_s = stop_at - record_from
+    return result
+
+
 def closed_loop_concurrency(buckets: list[int], n_chips: int = 1,
                             per_chip_cap: int = 384) -> int:
     """Loadgen connection count for a closed-loop bench run.
@@ -494,6 +703,15 @@ def run_loadgen_cli(args) -> int:
     url = f"{args.url}/v1/models/{args.model}:{args.verb}"
     warmup = getattr(args, "warmup", 2.0)
     rate = getattr(args, "rate", None)
+    if getattr(args, "stream", False):
+        # Streaming closed loop (ISSUE 17): one stream in flight per
+        # worker; --rate/--procs don't apply (event timestamps, not
+        # request completions, are the measurement).
+        result = asyncio.run(run_stream_load(
+            url, payload, content_type, args.duration, args.concurrency,
+            warmup))
+        print(json.dumps(result.summary()))
+        return 0 if result.n_ok > 0 else 1
     if rate:
         result = asyncio.run(run_load_open(
             url, payload, content_type, rate, args.duration, warmup,

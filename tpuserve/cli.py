@@ -104,6 +104,13 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--dump-latencies", default=None,
                          help="write raw latency samples as JSON to this "
                               "path (the multi-process merge reads them)")
+    p_bench.add_argument("--stream", action="store_true",
+                         help="closed-loop STREAMING mode (?stream=true, "
+                              "SSE): reports first-token p50/p99, "
+                              "inter-token-gap p99, and exact tokens/s "
+                              "from token event timestamps; use with "
+                              "--synthetic prompt against a generative "
+                              "model (--rate/--procs don't apply)")
 
     p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
     p_imp.add_argument("--saved-model", required=True)
@@ -149,7 +156,7 @@ def main(argv: list[str] | None = None) -> int:
                          help="exit non-zero when n_ok/(n_ok+n_err) falls below this")
     p_chaos.add_argument("--drill",
                          choices=["reload", "worker_kill", "host_kill",
-                                  "fleet", "autopilot"],
+                                  "stream_kill", "fleet", "autopilot"],
                          default=None,
                          help="additionally drive a drill during the run: "
                               "'reload' POSTs :reload on an interval so "
@@ -168,6 +175,16 @@ def main(argv: list[str] | None = None) -> int:
                               "reports per-model isolation — the victim's "
                               "breaker must open while every survivor "
                               "holds its SLO (docs/ROBUSTNESS.md); "
+                              "'stream_kill' serves a router + worker "
+                              "fleet with a generative model, drives "
+                              "mixed streaming + unary load, SIGKILLs "
+                              "one worker mid-stream, and byte-audits "
+                              "the fail-safe stream semantics: every "
+                              "started stream ends in a terminal event "
+                              "(zero torn streams, zero duplicate or "
+                              "reordered tokens vs a seeded reference) "
+                              "while un-started streams retry "
+                              "transparently; "
                               "'autopilot' serves a tenant-fenced fleet "
                               "with the self-healing controller engaged, "
                               "turns one tenant hostile mid-load while a "
@@ -258,6 +275,17 @@ def main(argv: list[str] | None = None) -> int:
                 cfg, model, duration_s=args.duration, warmup_s=args.warmup,
                 concurrency=args.concurrency, kill_after_s=args.kill_after,
                 reabsorb_budget_s=args.respawn_budget))
+        elif args.drill == "stream_kill":
+            # Mid-stream chaos drill (ISSUE 17): SIGKILL one worker while
+            # streams are in flight; gated availability is the unary
+            # load's, and the stream audit (torn/duplicates/byte-diff vs
+            # a seeded reference) is asserted by scripts/stream_drill.sh.
+            from tpuserve.workerproc.drill import run_stream_kill_drill
+
+            summary = asyncio.run(run_stream_kill_drill(
+                cfg, model, duration_s=args.duration, warmup_s=args.warmup,
+                concurrency=args.concurrency, kill_after_s=args.kill_after,
+                respawn_budget_s=args.respawn_budget))
         elif args.drill == "autopilot":
             # Hostile-tenant drill (ISSUE 16): one tenant floods past its
             # quota while a seeded [faults] latency rule fires mid-load on

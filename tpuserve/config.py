@@ -45,6 +45,11 @@ FAULT_KINDS = (
     "worker_crash",     # os._exit the serving process mid-request (native crash)
     "worker_hang",      # wedge the serving process: the request never answers
     "worker_slow",      # sleep delay_ms in the serving process before decode
+    "stream_stall",     # stop writing a started stream (consumer wedged):
+                        # the reader sees heartbeats dry up / idle timeout
+    "stream_disconnect",  # abruptly close a started stream's transport with
+                          # NO terminal event (the torn-stream shape clients
+                          # must treat as an error)
 )
 
 
@@ -269,11 +274,31 @@ class GenserveConfig:
     # Max queued requests folded into free slots per iteration; 0 = fill
     # every free slot (bounding it smooths per-iteration insert cost).
     admit_per_step: int = 0
+    # Streaming (ISSUE 17, docs/ROBUSTNESS.md "Streaming failure
+    # semantics"): per-request emission queue depth between the step loop
+    # and the HTTP writer. A full queue applies the model's stream_policy
+    # (drop droppable progress units, or block the slot).
+    stream_queue: int = 64
+    # SSE heartbeat comments (": hb") across idle emission gaps, so a
+    # proxy/client can distinguish "still generating" from a dead stream;
+    # 0 disables heartbeats.
+    stream_heartbeat_s: float = 5.0
+    # Graceful-drain stream budget: on SIGTERM, in-flight STREAMS get this
+    # long to finish before the engine terminates stragglers with the
+    # well-formed error event (reason "drain" — never a silent
+    # truncation); 0 = streams only get the shared drain_timeout_s.
+    stream_drain_s: float = 5.0
 
     def __post_init__(self) -> None:
         if self.slots < 0 or self.admit_per_step < 0:
             raise ValueError(
                 "genserve.slots/admit_per_step must be >= 0")
+        if self.stream_queue < 1:
+            raise ValueError(
+                f"genserve.stream_queue must be >= 1, got {self.stream_queue}")
+        if self.stream_heartbeat_s < 0 or self.stream_drain_s < 0:
+            raise ValueError(
+                "genserve.stream_heartbeat_s/stream_drain_s must be >= 0")
 
 
 @dataclass
@@ -447,11 +472,21 @@ class SloConfig:
     # Burn-rate threshold: FIRING when exceeded over both the short and
     # mid [telemetry] windows, PENDING on the short alone.
     burn_alert: float = 10.0
+    # First-token (first-unit) objective for STREAMED generation (ISSUE
+    # 17): a stream is "good" when its first emitted unit landed within
+    # this many ms (fed by gen_first_unit_ms{model=}). Evaluated by the
+    # same burn-rate machinery as latency_ms, surfaced on /alerts as
+    # "<model>:first_unit" and in the autopilot's shed-on-burn seam.
+    # 0 (default) disables the first-token SLO.
+    first_unit_ms: float = 0.0
 
     def __post_init__(self) -> None:
         if self.latency_ms < 0:
             raise ValueError(
                 f"slo.latency_ms must be >= 0, got {self.latency_ms}")
+        if self.first_unit_ms < 0:
+            raise ValueError(
+                f"slo.first_unit_ms must be >= 0, got {self.first_unit_ms}")
         if not 0.0 < self.availability < 1.0:
             raise ValueError(
                 f"slo.availability must be in (0, 1), got {self.availability}")
@@ -823,6 +858,16 @@ class RouterConfig:
     # controller (or an operator via /admin/hosts/{hid}:scale) activates
     # them. 0 = all `workers` slots active (the pre-autopilot behavior).
     active_workers: int = 0
+    # Streaming relay (ISSUE 17): per-stream idle timeout — a STARTED
+    # stream whose worker goes silent (no chunk) this long is terminated
+    # with the well-formed error event (reason "idle_timeout"), distinct
+    # from the absolute request deadline. 0 disables the idle timeout
+    # (only the deadline bounds the stream).
+    stream_idle_timeout_ms: float = 30000.0
+    # Router-side graceful-drain stream budget: on SIGTERM, in-flight
+    # streams get this long to finish before the router terminates them
+    # with the error event (reason "drain"); 0 = only drain_timeout_s.
+    stream_drain_s: float = 5.0
 
     def __post_init__(self) -> None:
         if self.workers < 1:
@@ -855,6 +900,9 @@ class RouterConfig:
             raise ValueError(
                 "router.peer_sync_interval_s must be > 0 and "
                 "peer_port >= 0")
+        if self.stream_idle_timeout_ms < 0 or self.stream_drain_s < 0:
+            raise ValueError(
+                "router.stream_idle_timeout_ms/stream_drain_s must be >= 0")
 
 
 @dataclass
@@ -990,6 +1038,14 @@ class ModelConfig:
     # only for models that are genuinely nondeterministic in their input
     # (e.g. unseeded sampling).
     cacheable: bool = True
+    # Streaming slow-consumer policy (ISSUE 17): what the engine does when
+    # a stream's bounded emission queue is full because the client reads
+    # slowly. "drop" discards DROPPABLE units (progress/preview events —
+    # counted in gen_stream_dropped_total; tokens and terminals are never
+    # dropped) and blocks only on non-droppable ones; "block" always
+    # blocks the step loop (exact delivery, at the cost of backpressuring
+    # the whole slot block).
+    stream_policy: str = "drop"
     # Service-level objective ([model.slo] sub-table): latency objective +
     # availability target the telemetry plane's burn-rate engine evaluates
     # (docs/OBSERVABILITY.md "The telemetry plane"). Defaults to disabled
@@ -1020,6 +1076,10 @@ class ModelConfig:
             raise ValueError(
                 f"priority must be 'interactive' or 'batch', "
                 f"got {self.priority!r}")
+        if self.stream_policy not in ("drop", "block"):
+            raise ValueError(
+                f"stream_policy must be 'drop' or 'block', "
+                f"got {self.stream_policy!r}")
         if self.cold_start and self.session_mode != "direct":
             raise ValueError(
                 "cold_start requires session_mode = 'direct' (recycle-mode "

@@ -38,6 +38,10 @@ class SlotInfo:
     # Request trace context (obs.TraceContext, ISSUE 12): the engine tags
     # this slot's fold-in/step/evict/retire events with its trace id.
     ctx: Any = None
+    # Emission channel for a streamed request (engine.GenStream, ISSUE 17);
+    # None for unary. Rides the ledger so every release path — retire,
+    # evict, disconnect, engine failure — can push the terminal unit.
+    stream: Any = None
     meta: dict = field(default_factory=dict)
 
 

@@ -68,6 +68,50 @@ class _GenRequest:
     priority: str | None = None
     # Request trace context (obs.TraceContext, ISSUE 12); None untraced.
     ctx: Any = None
+    # Emission channel for a streamed request (ISSUE 17); None for unary.
+    stream: "GenStream | None" = None
+
+
+def _retrieve_exception(fut: asyncio.Future) -> None:
+    """Streamed requests surface failures as error terminal units on the
+    stream; the future stays for cancellation + bookkeeping. Retrieve the
+    exception so asyncio never logs 'exception was never retrieved'."""
+    if not fut.cancelled():
+        fut.exception()
+
+
+class GenStream:
+    """Consumer handle for one streamed generation (ISSUE 17): a bounded
+    queue of unit dicts the engine produces and the HTTP layer drains.
+    Exactly one terminal unit ("done" or "error") always arrives — every
+    engine failure path enqueues one — so a client can always distinguish
+    a complete stream from a torn transport. ``close()`` is the consumer's
+    abandon signal (client disconnect): it stops further emission and
+    unblocks a producer waiting on the full queue."""
+
+    __slots__ = ("queue", "policy", "state", "first_unit_at", "terminated",
+                 "dropped")
+
+    def __init__(self, maxsize: int, policy: str) -> None:
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=max(1, maxsize))
+        self.policy = policy  # ModelConfig.stream_policy: "drop" | "block"
+        self.state: dict = {}  # the model's incremental emission state
+        self.first_unit_at: float | None = None
+        # Terminal enqueued (or consumer gone): emission is over.
+        self.terminated = False
+        self.dropped = 0
+
+    async def get(self) -> dict:
+        return await self.queue.get()
+
+    def close(self) -> None:
+        """Consumer gone: stop emission and free any blocked producer."""
+        self.terminated = True
+        while True:
+            try:
+                self.queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return
 
 
 class GenEngine:
@@ -118,6 +162,15 @@ class GenEngine:
         self._h_extract = metrics.histogram(f"gen_extract_ms{{model={name}}}")
         self._h_queue = metrics.histogram(
             f"latency_ms{{model={name},phase=queue}}")
+        # Streaming (ISSUE 17): first-unit latency feeds the first-token
+        # SLO; the terminated counter is per-reason (created on demand).
+        self._h_first_unit = metrics.histogram(
+            f"gen_first_unit_ms{{model={name}}}")
+        self._c_streams = metrics.counter(f"gen_streams_total{{model={name}}}")
+        self._c_disconnects = metrics.counter(
+            f"gen_client_disconnects_total{{model={name}}}")
+        self._c_stream_dropped = metrics.counter(
+            f"gen_stream_dropped_total{{model={name}}}")
         self._default_priority = getattr(model.cfg, "priority", "interactive")
         self._h_qwait = {p: metrics.queue_wait_histogram(name, p)
                          for p in PRIORITIES}
@@ -141,6 +194,10 @@ class GenEngine:
         # Runaway guard: a slot that somehow never reports done is failed
         # (and freed) past this bound instead of pinning its slot forever.
         self._max_steps_guard = 2 * max(1, model.gen_max_steps())
+        # Drain's bounded stream budget: once set (perf_counter clock),
+        # still-open streams past it terminate with the "drain" error
+        # event instead of holding the drain hostage.
+        self._stream_kill_at: float | None = None
 
     # -- compilation ----------------------------------------------------------
     def compile(self) -> None:
@@ -222,9 +279,11 @@ class GenEngine:
         err = RuntimeError(f"server shutting down; {self.name} not served")
         while self._pending:
             req = self._pending.popleft()
+            self._terminate_stream(req.stream, "shutdown", str(err))
             if not req.future.done():
                 req.future.set_exception(err)
         for info in self.arena.release_all():
+            self._terminate_stream(info.stream, "shutdown", str(err))
             if not info.future.done():
                 info.future.set_exception(err)
         self._g_queue_depth.set(0)
@@ -252,19 +311,27 @@ class GenEngine:
     async def drain(self, deadline: float) -> bool:
         """Graceful drain: wait until every accepted request (queued or
         mid-generation) resolved, bounded by ``deadline`` (event-loop
-        clock). Same idle-event discipline as the batcher."""
+        clock). Same idle-event discipline as the batcher. Streams get
+        their own bounded budget inside the window (gcfg.stream_drain_s):
+        past it the scheduling passes terminate stragglers with the
+        "drain" error event — a well-formed torn-stream signal, never a
+        silent truncation or an unbounded drain."""
         loop = asyncio.get_running_loop()
-        while self._pending or self.arena.n_active:
-            timeout = deadline - loop.time()
-            if timeout <= 0:
-                break
-            self._idle_event.clear()
-            if not self._pending and not self.arena.n_active:
-                break
-            try:
-                await asyncio.wait_for(self._idle_event.wait(), timeout)
-            except asyncio.TimeoutError:
-                break
+        self._stream_kill_at = time.perf_counter() + self.gcfg.stream_drain_s
+        try:
+            while self._pending or self.arena.n_active:
+                timeout = deadline - loop.time()
+                if timeout <= 0:
+                    break
+                self._idle_event.clear()
+                if not self._pending and not self.arena.n_active:
+                    break
+                try:
+                    await asyncio.wait_for(self._idle_event.wait(), timeout)
+                except asyncio.TimeoutError:
+                    break
+        finally:
+            self._stream_kill_at = None
         self._maybe_idle()
         return not self._pending and not self.arena.n_active
 
@@ -279,6 +346,27 @@ class GenEngine:
         labels the queue-wait histogram (arbitration happened upstream).
         ``ctx`` (obs.TraceContext) collects the request's queue/fold-in/
         step/evict/retire spans, tagged with its slot (ISSUE 12)."""
+        return self._enqueue(item, deadline_at, priority, ctx, None)
+
+    def submit_stream(self, item: Any, deadline_at: float | None = None,
+                      priority: str | None = None,
+                      ctx: Any = None) -> "tuple[asyncio.Future, GenStream]":
+        """Enqueue one streamed generation -> (future, stream). The HTTP
+        layer consumes ONLY the stream (units ending in one terminal —
+        every failure path pushes an error terminal, so the queue is the
+        single channel); the future exists for disconnect cancellation.
+        Raises QueueFull exactly like submit (a shed stream was never
+        started — plain 429, no stream semantics involved)."""
+        stream = GenStream(self.gcfg.stream_queue,
+                           getattr(self.cfg, "stream_policy", "drop"))
+        fut = self._enqueue(item, deadline_at, priority, ctx, stream)
+        fut.add_done_callback(_retrieve_exception)
+        self._c_streams.inc()
+        return fut, stream
+
+    def _enqueue(self, item: Any, deadline_at: float | None,
+                 priority: str | None, ctx: Any,
+                 stream: "GenStream | None") -> asyncio.Future:
         if not self._running or self._work_event is None:
             raise RuntimeError(f"engine for {self.name} not started")
         if len(self._pending) >= self.cfg.max_queue:
@@ -287,11 +375,119 @@ class GenEngine:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append(_GenRequest(
             item=item, future=fut, enqueued_at=time.perf_counter(),
-            deadline_at=deadline_at, priority=priority, ctx=ctx))
+            deadline_at=deadline_at, priority=priority, ctx=ctx,
+            stream=stream))
         self._g_queue_depth.set(len(self._pending))
         self._idle_event.clear()
         self._work_event.set()
         return fut
+
+    # -- stream emission (event loop; ISSUE 17) -------------------------------
+    def _count_termination(self, reason: str) -> None:
+        self.metrics.counter(
+            f"gen_stream_terminated_total{{model={self.name},"
+            f"reason={reason}}}").inc()
+
+    def _terminate_stream(self, stream: "GenStream | None", reason: str,
+                          message: str | None = None,
+                          unit: dict | None = None) -> None:
+        """Enqueue the terminal unit (sync-safe: callable from scheduling
+        passes and stop()). The terminal is never dropped — on a full
+        queue the oldest buffered unit makes room; the terminal outranks
+        any backlog because the stream is ending either way."""
+        if stream is None or stream.terminated:
+            return
+        stream.terminated = True
+        if unit is None:
+            unit = {"type": "error", "error": reason,
+                    "message": message or reason}
+        q = stream.queue
+        while True:
+            try:
+                q.put_nowait(unit)
+                break
+            except asyncio.QueueFull:
+                try:
+                    q.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+        self._count_termination(reason)
+
+    async def _emit_unit(self, stream: "GenStream", unit: dict) -> None:
+        """Policy-aware in-flight emission. A droppable unit under policy
+        "drop" is discarded when the consumer lags (gen_stream_dropped_
+        total); everything else blocks the step loop until the consumer
+        drains — re-checking the terminated flag every 50 ms so an
+        abandoned stream can never wedge the engine."""
+        if stream.terminated:
+            return
+        if unit.get("droppable") and stream.policy == "drop":
+            if stream.queue.full():
+                stream.dropped += 1
+                self._c_stream_dropped.inc()
+                return
+            stream.queue.put_nowait(unit)
+            return
+        while not stream.terminated:
+            if not self._running:
+                # stop() is tearing the engine down; it sends the
+                # "shutdown" terminal itself once the loop exits.
+                return
+            kill_at = self._stream_kill_at
+            if kill_at is not None and time.perf_counter() >= kill_at:
+                # Draining and the stream budget is spent: a wedged
+                # consumer must not hold the step loop (and the drain)
+                # open — it gets the "drain" terminal instead.
+                self._terminate_stream(stream, "drain",
+                                       "server draining; stream budget spent")
+                return
+            try:
+                await asyncio.wait_for(stream.queue.put(unit), 0.05)
+                return
+            except asyncio.TimeoutError:
+                continue
+
+    async def _emit_step_units(self, out: dict) -> None:
+        """Flush each streaming slot's newly produced units for this
+        iteration (the per-iteration flushing Orca's frame makes natural),
+        plus the family's optional preview extract — which reuses the
+        compiled extract program, so previews never add a compile."""
+        model = self.model
+        for slot in self.arena.active_slots():
+            info = self.arena.peek(slot)
+            stream = info.stream
+            if stream is None or stream.terminated or info.future.done():
+                continue
+            try:
+                units = model.stream_units(out, slot, stream.state)
+            except Exception:  # noqa: BLE001 — emission must not kill a slot
+                log.exception("stream_units failed for %s slot %d",
+                              self.name, slot)
+                continue
+            if units and stream.first_unit_at is None:
+                now = time.perf_counter()
+                stream.first_unit_at = now
+                ms = (now - info.enqueued_at) * 1e3
+                tid = info.ctx.trace_id if info.ctx is not None else None
+                self._h_first_unit.observe(ms, trace_id=tid)
+                if info.ctx is not None:
+                    wall = time.time()
+                    info.ctx.span("first_unit", wall - ms / 1e3, wall,
+                                  tid=self.name, slot=slot)
+            for u in units:
+                await self._emit_unit(stream, u)
+            if model.stream_wants_preview(out, slot, stream.state):
+                try:
+                    extracted = await self.stages.run(
+                        self.name, "fetch", self._extract_sync, slot)
+                    u = model.stream_preview_unit(extracted, stream.state)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — a preview is best-effort
+                    log.exception("preview extract failed for %s slot %d",
+                                  self.name, slot)
+                else:
+                    await self._emit_unit(stream, u)
 
     def _maybe_idle(self) -> None:
         if self._idle_event is not None and not self._pending \
@@ -301,7 +497,13 @@ class GenEngine:
     # -- step loop (event loop) -----------------------------------------------
     async def _step_loop(self) -> None:
         name = self.name
-        while True:
+        # The loop condition (not just task cancellation) gates each
+        # iteration: asyncio.wait_for can swallow a cancel that lands the
+        # same tick its inner future completes, and a step loop that
+        # survived its own cancellation would leave stop() awaiting it
+        # forever. _running goes False before stop() cancels, so either
+        # path exits.
+        while self._running:
             if self.injector is not None:
                 # Chaos: an escaped exception kills this task — exactly the
                 # failure revive_group_loops exists to repair.
@@ -352,6 +554,7 @@ class GenEngine:
             except Exception as e:  # noqa: BLE001 — contained per batch
                 await self._fail_active(e)
                 continue
+            await self._emit_step_units(out)
             await self._retire(out)
 
     def _step_sync(self) -> dict:
@@ -376,16 +579,28 @@ class GenEngine:
         if not self._pending:
             return
         now = time.perf_counter()
+        kill_at = self._stream_kill_at
         live: collections.deque[_GenRequest] = collections.deque()
         n_expired = 0
         for req in self._pending:
             if req.future.done():
+                if req.stream is not None:
+                    req.stream.close()  # consumer already gone
                 continue
             if req.deadline_at is not None and now >= req.deadline_at:
-                req.future.set_exception(DeadlineExceeded(
-                    "deadline expired after "
-                    f"{(now - req.enqueued_at) * 1e3:.0f} ms in queue"))
+                msg = ("deadline expired after "
+                       f"{(now - req.enqueued_at) * 1e3:.0f} ms in queue")
+                self._terminate_stream(req.stream, "deadline_exceeded", msg)
+                req.future.set_exception(DeadlineExceeded(msg))
                 n_expired += 1
+                continue
+            if req.stream is not None and kill_at is not None \
+                    and now >= kill_at:
+                # Drain's stream budget spent before this one ever started.
+                self._terminate_stream(req.stream, "drain",
+                                       "server draining; stream budget spent")
+                req.future.set_exception(RuntimeError(
+                    f"{self.name}: draining; stream budget spent"))
                 continue
             live.append(req)
         if n_expired:
@@ -403,21 +618,47 @@ class GenEngine:
         model's step bound, so the garbage compute is bounded and the
         ledger stays exact."""
         now = time.perf_counter()
+        kill_at = self._stream_kill_at
         for slot in self.arena.active_slots():
             info = self.arena.peek(slot)
             if info.future.done():  # client disconnected mid-generation
+                if info.stream is not None:
+                    self._c_disconnects.inc()
+                    self._count_termination("disconnect")
+                    info.stream.close()
                 self.arena.release(slot)
                 continue
             if info.deadline_at is not None and now >= info.deadline_at:
-                info.future.set_exception(DeadlineExceeded(
-                    f"deadline expired after {info.iterations} iteration(s) "
-                    f"({(now - info.enqueued_at) * 1e3:.0f} ms total)"))
+                msg = (f"deadline expired after {info.iterations} "
+                       "iteration(s) "
+                       f"({(now - info.enqueued_at) * 1e3:.0f} ms total)")
+                # Deadline-contract split (ISSUE 17): before the first unit
+                # the HTTP layer still answers a plain fast 504; after it,
+                # this terminal becomes the in-stream error event naming
+                # deadline_exceeded — either way, never a silent cut.
+                self._terminate_stream(info.stream, "deadline_exceeded", msg)
+                info.future.set_exception(DeadlineExceeded(msg))
                 self._c_deadline.inc()
                 self._c_evictions.inc()
                 if info.ctx is not None:
                     wall = time.time()
                     info.ctx.span("evict", wall, wall, tid=self.name,
                                   slot=slot, iterations=info.iterations)
+                self.arena.release(slot)
+                continue
+            if info.stream is not None and kill_at is not None \
+                    and now >= kill_at:
+                self._terminate_stream(info.stream, "drain",
+                                       "server draining; stream budget spent")
+                info.future.set_exception(RuntimeError(
+                    f"{self.name}: draining; stream terminated after "
+                    f"{info.iterations} iteration(s)"))
+                self._c_evictions.inc()
+                if info.ctx is not None:
+                    wall = time.time()
+                    info.ctx.span("evict", wall, wall, tid=self.name,
+                                  slot=slot, iterations=info.iterations,
+                                  reason="drain")
                 self.arena.release(slot)
         self._g_active.set(self.arena.n_active)
 
@@ -433,9 +674,10 @@ class GenEngine:
                 continue
             now = time.perf_counter()
             if req.deadline_at is not None and now >= req.deadline_at:
-                req.future.set_exception(DeadlineExceeded(
-                    "deadline expired after "
-                    f"{(now - req.enqueued_at) * 1e3:.0f} ms in queue"))
+                msg = ("deadline expired after "
+                       f"{(now - req.enqueued_at) * 1e3:.0f} ms in queue")
+                self._terminate_stream(req.stream, "deadline_exceeded", msg)
+                req.future.set_exception(DeadlineExceeded(msg))
                 self._c_deadline.inc()
                 continue
             fold = any(self.arena.peek(s).iterations > 0
@@ -443,7 +685,7 @@ class GenEngine:
             info = SlotInfo(item=req.item, future=req.future,
                             deadline_at=req.deadline_at,
                             enqueued_at=req.enqueued_at, admitted_at=now,
-                            ctx=req.ctx)
+                            ctx=req.ctx, stream=req.stream)
             slot = self.arena.acquire(info)
             wait_ms = (now - req.enqueued_at) * 1e3
             trace_id = req.ctx.trace_id if req.ctx is not None else None
@@ -465,6 +707,7 @@ class GenEngine:
                 # consumed on TPU): hard-reset like a step failure. The
                 # admitting request fails with the cause too.
                 self.arena.release(slot)
+                self._terminate_stream(req.stream, "engine_error", str(e))
                 if not req.future.done():
                     req.future.set_exception(e)
                 await self._fail_active(e)
@@ -494,13 +737,18 @@ class GenEngine:
         for slot in self.arena.active_slots():
             info = self.arena.peek(slot)
             if info.future.done():
+                if info.stream is not None:
+                    self._c_disconnects.inc()
+                    self._count_termination("disconnect")
+                    info.stream.close()
                 self.arena.release(slot)
                 continue
             if info.iterations > self._max_steps_guard:
-                info.future.set_exception(RuntimeError(
-                    f"{self.name}: slot {slot} exceeded the "
-                    f"{self._max_steps_guard}-iteration guard without "
-                    "reporting done"))
+                msg = (f"{self.name}: slot {slot} exceeded the "
+                       f"{self._max_steps_guard}-iteration guard without "
+                       "reporting done")
+                self._terminate_stream(info.stream, "engine_error", msg)
+                info.future.set_exception(RuntimeError(msg))
                 self._c_batch_errors.inc()
                 self.arena.release(slot)
                 continue
@@ -524,9 +772,22 @@ class GenEngine:
                 self._c_batch_errors.inc()
                 if self.breaker is not None:
                     self.breaker.record_failure()
+                self._terminate_stream(info.stream, "engine_error", str(e))
                 if not info.future.done():
                     info.future.set_exception(e)
             else:
+                if info.stream is not None and not info.stream.terminated:
+                    # Terminal burst: the family's final units (sd15's
+                    # image, then done with finish reason + usage). The
+                    # done unit goes through _terminate_stream so its
+                    # delivery is unconditional and the per-reason
+                    # counter sees a "done".
+                    finals = self.model.stream_final_units(extracted, result)
+                    for u in finals[:-1]:
+                        await self._emit_unit(info.stream, u)
+                    self._terminate_stream(
+                        info.stream, "done",
+                        unit=finals[-1] if finals else {"type": "done"})
                 if not info.future.done():
                     info.future.set_result(result)
                 self._c_items.inc()
@@ -563,6 +824,7 @@ class GenEngine:
             self.breaker.record_failure()
         wall = time.time()
         for info in self.arena.release_all():
+            self._terminate_stream(info.stream, "engine_error", str(e))
             if not info.future.done():
                 info.future.set_exception(e)
             if info.ctx is not None:

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from tpuserve import frame as frame_wire
 from tpuserve.config import ModelConfig
 from tpuserve.genserve.model import GenerativeModel
 from tpuserve.text import CLIPBPETokenizer, WordPieceTokenizer, synthetic_vocab
@@ -409,6 +410,13 @@ class SD15Serving(GenerativeModel):
         self.dtype = jnp.dtype(cfg.dtype)
         self.steps = int(o.get("steps", 20))
         self.guidance = float(o.get("guidance", 7.5))
+        # Streamed responses emit a decoded preview image every N denoise
+        # steps (0 disables). Each preview reuses the compiled extract
+        # program — previews never add a compile, only extract invocations.
+        self.preview_every = int(o.get("preview_every", 0))
+        if self.preview_every < 0:
+            raise ValueError(
+                f"options.preview_every must be >= 0, got {self.preview_every}")
         # The VAE upsamples 2x per level past the first, so the latent edge
         # must be image_size / 2^(levels-1) for the PNG to match image_size
         # (8x for the standard 4-level SD VAE).
@@ -584,6 +592,53 @@ class SD15Serving(GenerativeModel):
 
     def finalize(self, extracted: Any, item: Any) -> bytes:
         return self._png(np.asarray(extracted["image"]))
+
+    # -- streaming (ISSUE 17) ---------------------------------------------------
+    # sd15 streams over the chunked binary frame wire: KIND_EVENT frames
+    # carry progress/done/error JSON, single-item KIND_RGB8 frames carry
+    # previews and the final image. Everything except the final image and
+    # the terminal is droppable — a slow reader loses progress, never art.
+    def stream_units(self, step_out: dict, slot: int, stream: dict) -> list:
+        s = int(step_out["step_i"][slot])
+        sent = int(stream.get("sent", 0))
+        if s <= sent:
+            return []
+        stream["sent"] = s
+        return [{"type": "progress", "step": i, "steps": self.steps,
+                 "droppable": True} for i in range(sent + 1, s + 1)]
+
+    def stream_wants_preview(self, step_out: dict, slot: int,
+                             stream: dict) -> bool:
+        if not self.preview_every or bool(step_out["done"][slot]):
+            return False
+        s = int(step_out["step_i"][slot])
+        return s - int(stream.get("previewed", 0)) >= self.preview_every
+
+    def stream_preview_unit(self, extracted: Any, stream: dict) -> dict:
+        stream["previewed"] = int(stream.get("sent", 0))
+        return {"type": "preview", "image": np.asarray(extracted["image"]),
+                "droppable": True}
+
+    def stream_final_units(self, extracted: Any, result: Any) -> list:
+        return ([{"type": "image", "image": np.asarray(extracted["image"])}]
+                + super().stream_final_units(extracted, result))
+
+    def stream_usage(self, result: Any) -> dict:
+        return {"images": 1}
+
+    def stream_content_type(self) -> str:
+        return frame_wire.CONTENT_TYPE
+
+    def encode_stream_unit(self, unit: dict) -> bytes:
+        if unit["type"] in ("image", "preview"):
+            return frame_wire.encode_frame(
+                [unit["image"]], frame_wire.KIND_RGB8, self.cfg.image_size)
+        data = {k: v for k, v in unit.items() if k != "droppable"}
+        return frame_wire.encode_stream_event(
+            json.dumps(data).encode("utf-8"))
+
+    def stream_heartbeat(self) -> bytes:
+        return frame_wire.encode_stream_event(b'{"type": "hb"}')
 
     # -- host side --------------------------------------------------------------
     def _tokenize(self, prompt: str) -> np.ndarray:

@@ -287,7 +287,10 @@ class TextGenServing(GenerativeModel):
             "seed": state["seed"], "max_new": state["max_new"],
             "temp": state["temp"],
         }
-        return new_state, {"done": done2, "n_new": n_new2}
+        # The token buffer rides the per-step host fetch (slots x max_new
+        # int32 — tens of KB) so the engine's emission channel can stream
+        # each token the iteration it lands, without extra device reads.
+        return new_state, {"done": done2, "n_new": n_new2, "tokens": tokens}
 
     # -- one-shot path (locked batch: static batcher + bench baseline) --------
     def forward(self, params: Any, batch: Any) -> dict:
@@ -386,6 +389,37 @@ class TextGenServing(GenerativeModel):
     def result_units(self, result: Any) -> float:
         """Tokens generated — the tokens/s headline unit."""
         return float(result.get("n_tokens", 1))
+
+    # -- streaming (ISSUE 17) -------------------------------------------------
+    def stream_units(self, step_out: dict, slot: int, stream: dict) -> list:
+        """Token units newly landed for one slot this iteration. The text
+        delta is incremental detokenize: detokenize() is append-only under
+        WordPiece merges (a new word appends " w", a "##" continuation
+        appends its suffix, EOS/PAD add nothing), so the concatenation of
+        every unit's "text" equals the unary result's "text" byte-for-byte
+        — the stream drill's audit anchor."""
+        n = int(step_out["n_new"][slot])
+        sent = int(stream.get("sent", 0))
+        if n <= sent:
+            return []
+        toks = [int(t) for t in step_out["tokens"][slot][:n]]
+        prev = stream.get("text", "")
+        units = []
+        for i in range(sent, n):
+            text = self.detokenize(toks[: i + 1])
+            units.append({"type": "token", "text": text[len(prev):],
+                          "token": toks[i], "index": i})
+            prev = text
+        stream["sent"] = n
+        stream["text"] = prev
+        return units
+
+    def stream_finish_reason(self, result: Any) -> str:
+        toks = result.get("tokens") or []
+        return "stop" if toks and toks[-1] == self.eos_id else "length"
+
+    def stream_usage(self, result: Any) -> dict:
+        return {"completion_tokens": int(result.get("n_tokens", 0))}
 
     def host_postprocess(self, outputs: dict, n_valid: int) -> list[dict]:
         return [self._result(outputs["tokens"][r], outputs["n_new"][r])

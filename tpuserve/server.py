@@ -488,6 +488,18 @@ class ServerState:
             # are simply not evaluated.
             for mcfg in self.cfg.models:
                 self.slo.register(mcfg.name, mcfg.slo)
+                # First-token objective (ISSUE 17): a separate subject
+                # over the engine's gen_first_unit_ms histogram, so the
+                # autopilot's shed-on-burn seam sees streaming health —
+                # a model can meet its total-latency SLO while its
+                # time-to-first-token burns.
+                if mcfg.slo is not None and mcfg.slo.first_unit_ms > 0:
+                    self.slo.register(
+                        f"{mcfg.name}:first_unit",
+                        SloConfig(latency_ms=mcfg.slo.first_unit_ms,
+                                  availability=mcfg.slo.availability,
+                                  burn_alert=mcfg.slo.burn_alert),
+                        metric=f"gen_first_unit_ms{{model={mcfg.name}}}")
         if self.scheduler is not None:
             # Shed-on-burn seam (ISSUE 14): the scheduler can read each
             # model's live alert state (FleetScheduler.slo) — future PRs
@@ -954,7 +966,13 @@ async def handle_predict(request: web.Request) -> web.Response:
                   status=resp.status)
     if "X-Trace-Id" not in resp.headers:
         resp.headers["X-Trace-Id"] = ctx.trace_id
-    kinds = state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    # Streamed responses score by max(first-unit, largest gap) — set by
+    # _predict_stream — so a slow STREAM is catchable while a long healthy
+    # generation isn't misfiled as slow (ISSUE 17 satellite).
+    score_ms = getattr(resp, "tpuserve_stream_score_ms", None)
+    kinds = state.recorder.finish(
+        ctx, name, resp.status,
+        score_ms if score_ms is not None else dur_s * 1e3)
     if state.events is not None:
         # Trace-correlated flight data (ISSUE 15): errored/shed and
         # retained-slow requests leave an event carrying the trace id, so
@@ -977,6 +995,15 @@ async def _predict_traced(request: web.Request, state: ServerState,
     model = state.models.get(name)
     if model is None:
         return _err(404, f"unknown model {name!r}", trace=ctx)
+    # Query validation (shared validator, ISSUE 15 idiom): predict knows
+    # exactly two parameters; junk keys or a junk stream= value are a 400
+    # before any body work.
+    try:
+        events_mod.reject_unknown_query(request.query,
+                                        {"timeout_ms", "stream"})
+        want_stream = _requested_stream(request)
+    except ValueError as e:
+        return _err(400, str(e), trace=ctx)
     # Shed checks run BEFORE the body read: a draining replica or tripped
     # model answers in microseconds, with a Retry-After hint, instead of
     # paying decode + a doomed dispatch.
@@ -1134,6 +1161,25 @@ async def _predict_traced(request: web.Request, state: ServerState,
         h.bad_requests.inc()
         return _err(400, f"could not decode request: {e}", trace=ctx)
 
+    if want_stream:
+        # Streaming dispatch (ISSUE 17): straight to the generation
+        # engine's emission channel — no result cache, no single-flight
+        # (a stream must never coalesce onto a buffered leader or be
+        # answered from a cached body; it force-misses by construction).
+        eng = state.engines.get(name)
+        if eng is None:
+            h.bad_requests.inc()
+            return _err(400, f"model {name!r} does not support streaming "
+                             "(stream=true needs a [genserve]-served "
+                             "generative model)", trace=ctx)
+        if len(items) != 1:
+            h.bad_requests.inc()
+            return _err(400, "stream=true requires a single-item request",
+                        trace=ctx)
+        return await _predict_stream(request, state, name, model, h, eng,
+                                     items[0], deadline_at, timeout_s,
+                                     priority, tenant, ctx, t_start)
+
     # Demand-shaping layer (tpuserve.cache): per item, answer from the
     # content-addressed result cache, join an identical in-flight miss
     # (single-flight: one batch slot, the result fanned out), or lead a
@@ -1200,6 +1246,180 @@ async def _predict_traced(request: web.Request, state: ServerState,
         return web.Response(body=hit_entry.body,
                             content_type="application/json")
     return web.json_response(result)
+
+
+def _requested_stream(request: web.Request) -> bool:
+    """The ``?stream=`` query flag; ValueError (-> 400) on junk values —
+    a typo'd flag must fail loudly, not silently serve unary."""
+    raw = request.query.get("stream")
+    if raw is None:
+        return False
+    val = raw.strip().lower()
+    if val in ("true", "1"):
+        return True
+    if val in ("false", "0"):
+        return False
+    raise ValueError(
+        f'stream must be "true", "1", "false" or "0", got {raw!r}')
+
+
+def _stream_error_status(reason: str) -> int:
+    """Pre-first-unit terminal -> plain HTTP status (the fast-504 half of
+    the deadline contract: no bytes were written, so no stream semantics
+    are owed and the router's hedge/retry stays legal)."""
+    return {"deadline_exceeded": 504, "shutdown": 503, "drain": 503}.get(
+        reason, 500)
+
+
+async def _predict_stream(request: web.Request, state: ServerState,
+                          name: str, model, h: ModelHandles, eng,
+                          item, deadline_at: float, timeout_s: float,
+                          priority: str | None, tenant: str | None,
+                          ctx: TraceContext,
+                          t_start: float) -> web.StreamResponse:
+    """One streamed generation end-to-end (ISSUE 17 tentpole layer 2).
+
+    The engine's GenStream queue is the single channel: units flush per
+    engine iteration, heartbeats cover idle gaps, and exactly one terminal
+    ("done" with finish reason + usage, or "error" naming the cause)
+    closes every started stream. The deadline contract splits here: until
+    the first unit no bytes are written and failures stay plain statuses
+    (fast 504 — and the router's first-byte latch sees no body, keeping
+    hedges legal); after it, failures become in-stream error events. A
+    client disconnect cancels the engine future, freeing the slot for
+    fold-in (gen_client_disconnects_total ticks engine-side)."""
+
+    async def _submit():
+        try:
+            return eng.submit_stream(item, deadline_at=deadline_at,
+                                     priority=priority, ctx=ctx)
+        except QueueFull:
+            raise
+        except RuntimeError as e:
+            raise NotServing(str(e)) from e
+
+    try:
+        fut, stream = await _on_main(state, _submit)
+    except QueueFull:
+        return _err(429, "queue full, retry later",
+                    retry_after=state.queue_retry_after(name), trace=ctx)
+    except NotServing as e:
+        return _err(503, f"server not accepting requests: {e}", trace=ctx)
+
+    hb_s = eng.gcfg.stream_heartbeat_s
+    hb = model.stream_heartbeat()
+    encode = model.encode_stream_unit
+    resp: web.StreamResponse | None = None
+    terminal: dict | None = None
+    n_units = 0
+    last_write: float | None = None
+    first_unit_ms: float | None = None
+    max_gap_ms = 0.0
+    max_gap_end = 0.0
+    try:
+        while terminal is None:
+            if resp is None:
+                # Admission -> first unit: bounded by the request deadline
+                # plus the same 0.25 s backstop grace the unary path uses
+                # (the engine's fast-504 eviction normally answers first).
+                budget = max(0.0, deadline_at - time.perf_counter()) + 0.25
+                try:
+                    unit = await _on_main(state, lambda: asyncio.wait_for(
+                        stream.get(), budget))
+                except asyncio.TimeoutError:
+                    h.timeouts.inc()
+                    return _err(
+                        504,
+                        f"request deadline ({timeout_s * 1e3:.0f} ms) "
+                        "exceeded", trace=ctx)
+                if unit["type"] == "error":
+                    status = _stream_error_status(unit.get("error", ""))
+                    if status == 504:
+                        h.timeouts.inc()
+                    return _err(status,
+                                f"{unit.get('error', 'error')}: "
+                                f"{unit.get('message', '')}", trace=ctx)
+                resp = web.StreamResponse(status=200)
+                resp.content_type = model.stream_content_type()
+                resp.headers["X-Tpuserve-Stream"] = "1"
+                resp.headers["X-Trace-Id"] = ctx.trace_id
+                await resp.prepare(request)
+            else:
+                try:
+                    unit = await _on_main(state, lambda: asyncio.wait_for(
+                        stream.get(), hb_s if hb_s > 0 else None))
+                except asyncio.TimeoutError:
+                    if hb:
+                        await resp.write(hb)
+                    continue
+            now = time.perf_counter()
+            if first_unit_ms is None:
+                first_unit_ms = (now - t_start) * 1e3
+            elif last_write is not None:
+                gap = (now - last_write) * 1e3
+                if gap > max_gap_ms:
+                    max_gap_ms, max_gap_end = gap, time.time()
+            last_write = now
+            if unit["type"] in ("done", "error"):
+                terminal = unit
+            try:
+                await resp.write(encode(unit))
+            except (ConnectionResetError, ConnectionError):
+                # Client went away mid-write: the finally below cancels
+                # the engine future (slot frees for fold-in;
+                # gen_client_disconnects_total ticks engine-side).
+                return resp
+            n_units += 1
+            if state.injector is not None and terminal is None:
+                # Chaos on a STARTED stream (docs/ROBUSTNESS.md):
+                # stream_stall wedges the writer (the reader sees
+                # heartbeats dry up — the router's idle timeout owns it);
+                # stream_disconnect tears the transport with NO terminal
+                # event (the torn-stream shape clients must error on).
+                if state.injector.fire("stream_stall", name) is not None:
+                    await asyncio.sleep(_WORKER_HANG_S)
+                    return resp
+                if state.injector.fire("stream_disconnect",
+                                       name) is not None:
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
+    finally:
+        if terminal is None:
+            # Abandoned mid-stream (client disconnect, handler
+            # cancellation, injected tear): cancel the engine future so
+            # the slot frees for fold-in, and close the stream so a
+            # blocked producer wakes. Scheduled, not awaited — this
+            # finally may itself be running a cancellation.
+            def _abandon():
+                fut.cancel()
+                stream.close()
+
+            (state.main_loop
+             or asyncio.get_running_loop()).call_soon_threadsafe(_abandon)
+
+    # Stream health spans + recorder score (ISSUE 17 satellite): a
+    # stream's slowness is first-unit latency and the largest inter-unit
+    # gap — total wall time would score every long generation "slow".
+    wall_end = time.time()
+    if max_gap_ms > 0:
+        ctx.span("stream_gap", max_gap_end - max_gap_ms / 1e3, max_gap_end,
+                 tid=name, gap_ms=round(max_gap_ms, 3))
+    ctx.span("stream_terminal", wall_end, wall_end, tid=name,
+             type=terminal["type"],
+             finish_reason=(terminal.get("finish_reason")
+                            if terminal["type"] == "done"
+                            else terminal.get("error")),
+             units=n_units)
+    resp.tpuserve_stream_score_ms = max(first_unit_ms or 0.0, max_gap_ms)
+    if state.tenants is not None and tenant is not None:
+        # Charge wall occupancy; the tenant latency series gets the
+        # client-perceived responsiveness (first unit), not stream length.
+        total_ms = (time.perf_counter() - t_start) * 1e3
+        state.tenants.record(tenant, total_ms / 1e3,
+                             latency_ms=first_unit_ms or total_ms)
+    await resp.write_eof()
+    return resp
 
 
 async def handle_models(request: web.Request) -> web.Response:

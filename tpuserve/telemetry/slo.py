@@ -106,12 +106,17 @@ class SloEngine:
         self._models: dict[str, _ModelSlo] = {}
         self._lock = new_lock("telemetry.SloEngine")
 
-    def register(self, name: str, slo) -> bool:
+    def register(self, name: str, slo, metric: str | None = None) -> bool:
         """Track one model's [model.slo] block; False when it is disabled
-        (latency_ms = 0)."""
+        (latency_ms = 0). ``metric`` overrides the engine's metric_fmt for
+        subjects evaluated over a different histogram than the default —
+        the first-token objective (ISSUE 17) registers "<model>:first_unit"
+        over ``gen_first_unit_ms{model=}`` this way, reusing the whole
+        burn-window/alert state machine unchanged."""
         if slo is None or slo.latency_ms <= 0:
             return False
-        m = _ModelSlo(name, slo, self.metric_fmt.format(name=name),
+        m = _ModelSlo(name, slo,
+                      metric or self.metric_fmt.format(name=name),
                       self.metrics, self.windows, label=self.label)
         with self._lock:
             self._models[name] = m

@@ -347,6 +347,211 @@ async def run_worker_kill_drill(cfg: ServerConfig, model_name: str | None = None
     return out
 
 
+async def run_stream_kill_drill(cfg: ServerConfig,
+                                model_name: str | None = None,
+                                duration_s: float = 20.0,
+                                warmup_s: float = 1.0,
+                                concurrency: int = 16,
+                                kill_after_s: float | None = None,
+                                respawn_budget_s: float = 120.0) -> dict:
+    """Mid-stream chaos drill (ISSUE 17 tentpole part 4): serve a router
+    over >= 2 workers with a generative model, drive MIXED streaming +
+    unary load, SIGKILL one worker mid-load, and audit the fail-safe
+    stream semantics end-to-end:
+
+    - **zero silent truncations** — every stream that STARTED (the worker
+      committed a 200 + first bytes) ends in exactly one terminal event:
+      "done", or a well-formed "error" naming the cause. ``torn`` counts
+      streams that hit EOF with no terminal; it must be 0 even for the
+      streams cut by the SIGKILL (the router appends the terminal).
+    - **zero duplicate / reordered tokens** — every stream's token indices
+      must be exactly 0..n-1 (a post-latch re-dispatch would replay
+      tokens); ``order_violations`` must be 0.
+    - **byte audit vs the seeded reference** — one fixed (prompt, seed,
+      max_new_tokens) body streams throughout; generation is seeded-
+      deterministic and detokenize is append-only, so a "done" stream's
+      concatenated text must equal the unary reference EXACTLY
+      (``mismatched`` = 0) and an error-terminated stream's text must be
+      a strict PREFIX of it (``non_prefix`` = 0 — anything else is
+      corruption or replay).
+    - **un-started streams retry transparently** — a request the victim
+      never answered bytes for is re-dispatched to the survivor by the
+      router's pre-latch machinery; availability (gated by the CLI) is
+      the UNARY load's, the survivors' view.
+    - **zero survivor compiles** — the kill must not perturb the
+      survivors' compiled generation programs (compile_deltas all 0).
+    """
+    import aiohttp
+    from aiohttp import web
+
+    from tpuserve.bench.loadgen import (run_load, stream_generate,
+                                        synthetic_prompt_pool)
+    from tpuserve.obs import percentile
+    from tpuserve.workerproc.router import RouterState, make_router_app
+
+    cfg.router.enabled = True
+    cfg.router.workers = max(2, cfg.router.workers)
+    cfg.router.hosts = 0
+    # Streams bypass the cache structurally, but the unary availability
+    # load must execute for real too.
+    cfg.cache.enabled = False
+    model = model_name or cfg.models[0].name
+
+    state = RouterState(cfg)
+    app = make_router_app(state)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()  # on_startup spawns the fleet
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    base = f"http://127.0.0.1:{port}"
+    url = f"{base}/v1/models/{model}:generate"
+    ctype = "application/json"
+    import json as _json
+
+    # The audited stream payload: fixed (prompt, seed, cap) — seeded
+    # generation is deterministic across workers (identical seeded
+    # weights), so every stream of this body must yield the same tokens.
+    ref_body = _json.dumps({"prompt": "the quick brown fox jumps over",
+                            "seed": 7, "max_new_tokens": 24,
+                            "temperature": 0.7}).encode()
+    unary_pool = synthetic_prompt_pool(16, max_new=(2, 24))
+
+    kill_info: dict = {}
+    records: list[dict] = []
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+
+    async def _reference() -> str:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, data=ref_body,
+                              headers={"Content-Type": ctype}) as r:
+                body = await r.read()
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"reference request failed: {r.status} {body[:200]}")
+                return _json.loads(body)["text"]
+
+    async def _stream_client() -> None:
+        async with aiohttp.ClientSession() as session:
+            while not stop.is_set():
+                rec = await stream_generate(
+                    session, url, ref_body, {"Content-Type": ctype})
+                records.append(rec)
+                await asyncio.sleep(0.01)
+
+    async def _killer(survivor_urls: dict[int, str]) -> None:
+        await asyncio.sleep(warmup_s + (kill_after_s
+                                        if kill_after_s is not None
+                                        else duration_s * 0.25))
+        victim = state.supervisor.pick()
+        if victim is None:
+            kill_info["error"] = "no healthy worker to kill"
+            return
+        wid, pid = victim.wid, victim.pid
+        survivor_urls.pop(wid, None)  # victim is no compile-audit subject
+        log.warning("drill: SIGKILL worker %d (pid %d) mid-stream",
+                    wid, pid)
+        t0 = time.monotonic()
+        os.kill(pid, signal.SIGKILL)
+        kill_info.update(killed_worker=wid, killed_pid=pid)
+        deadline = t0 + respawn_budget_s
+        while time.monotonic() < deadline:
+            h = state.supervisor.slots[wid]
+            if h is not None and h.pid != pid and h.healthy:
+                kill_info["respawn_s"] = round(time.monotonic() - t0, 2)
+                return
+            await asyncio.sleep(0.05)
+        kill_info["respawn_s"] = None
+
+    try:
+        ref_text = await _reference()
+        survivor_urls = {w.wid: w.base_url
+                         for w in state.supervisor.live_workers()}
+        compiles_before = await _worker_compile_totals(dict(survivor_urls))
+        n_streamers = max(2, concurrency // 4)
+        stream_tasks = [loop.create_task(_stream_client())
+                        for _ in range(n_streamers)]
+        load_task = loop.create_task(run_load(
+            url, unary_pool, ctype, duration_s,
+            max(2, concurrency - n_streamers), warmup_s))
+        kill_task = loop.create_task(_killer(survivor_urls))
+        result = await load_task
+        await kill_task
+        stop.set()
+        await asyncio.gather(*stream_tasks)
+        compiles_after = await _worker_compile_totals(survivor_urls)
+        postmortems = await _await_postmortem(state)
+        workers = state.supervisor.stats()
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/metrics") as r:
+                metrics_text = await r.text() if r.status == 200 else ""
+    finally:
+        await runner.cleanup()  # on_cleanup -> state.stop() -> fleet drain
+
+    started = [r for r in records if r["status"] == 200]
+    done_s = [r for r in started if r["terminal"] == "done"]
+    error_s = [r for r in started if r["terminal"] == "error"]
+    first_tokens = [r["first_token_ms"] for r in started
+                    if r["first_token_ms"] is not None]
+    gaps = [(b - a) * 1e3 for r in done_s
+            for a, b in zip(r["token_times"], r["token_times"][1:])]
+    audit = {
+        "streams": len(records),
+        "started": len(started),
+        "done": len(done_s),
+        "error_terminals": len(error_s),
+        "error_reasons": {},
+        # The three zero-gates:
+        "torn": sum(1 for r in started if r["torn"]),
+        "order_violations": sum(
+            1 for r in started
+            if r["indices"] != list(range(len(r["indices"])))),
+        "mismatched": sum(1 for r in done_s if r["text"] != ref_text),
+        "non_prefix": sum(1 for r in error_s
+                          if not ref_text.startswith(r["text"])),
+        "junk_events": sum(r["junk"] for r in records),
+        # Pre-latch outcomes: the router retried or shed these with a
+        # plain status — no stream semantics owed.
+        "not_started": len(records) - len(started),
+        "first_token_p50_ms": round(percentile(first_tokens, 0.5), 3),
+        "first_token_p99_ms": round(percentile(first_tokens, 0.99), 3),
+        "inter_token_gap_p99_ms": round(percentile(gaps, 0.99), 3),
+    }
+    for r in error_s:
+        key = str(r["error"])
+        audit["error_reasons"][key] = audit["error_reasons"].get(key, 0) + 1
+    stream_terminated = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("router_stream_terminated_total"):
+            try:
+                k, v = line.rsplit(" ", 1)
+                stream_terminated[k] = float(v)
+            except ValueError:
+                pass
+
+    out = result.summary()
+    total = result.n_ok + result.n_err
+    out["availability"] = round(result.n_ok / total, 5) if total else 0.0
+    out["drill"] = "stream_kill"
+    out["postmortems"] = postmortems
+    out["kill"] = kill_info
+    out["stream_audit"] = audit
+    out["workers"] = workers
+    out["compile_deltas"] = {
+        str(wid): compiles_after.get(wid, compiles_before[wid])
+        - compiles_before[wid]
+        for wid in compiles_before if wid in compiles_after}
+    out["router"] = {
+        "retries_total": state.handles[model].retries.value,
+        "hedges_total": state.handles[model].hedges.value,
+        "streams_total": state.handles[model].streams.value,
+        "stream_terminated": stream_terminated,
+        "respawn_budget_s": respawn_budget_s,
+    }
+    return out
+
+
 async def _tenant_load(url: str, payload: bytes, ctype: str, api_key: str,
                        stop: asyncio.Event, out: dict, clients: int,
                        think_s: float = 0.0) -> None:

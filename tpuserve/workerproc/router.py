@@ -57,6 +57,7 @@ import time
 import aiohttp
 from aiohttp import web
 
+from tpuserve import frame
 from tpuserve.analysis import witness
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig, SloConfig
@@ -66,7 +67,8 @@ from tpuserve.obs import (FlightRecorder, Metrics, TraceContext,
 from tpuserve.scheduler.autopilot import (Action, AutopilotLoop,
                                           DomainSignal, ModelSignal, Signals)
 from tpuserve.scheduler.tenants import TenantLedger
-from tpuserve.server import _err, _requested_timeout_ms, configure_logging
+from tpuserve.server import (_err, _requested_stream, _requested_timeout_ms,
+                             configure_logging)
 from tpuserve.telemetry import (AuditLog, EventLog, MetricSampler,
                                 PostmortemLog, SloEngine, TimeSeriesStore,
                                 merge_expositions, parse_exposition)
@@ -136,11 +138,48 @@ class _RelayedError(Exception):
         self.ans = ans
 
 
+# Response header a worker stamps on a committed stream (ISSUE 17). Its
+# presence IS the router's first-byte latch: the worker will write body
+# bytes to this connection, so retries and hedges are no longer legal —
+# a re-dispatch could replay tokens the client already consumed.
+_STREAM_HEADER = "X-Tpuserve-Stream"
+
+
+class _StreamAnswer:
+    """A streaming worker response claimed at the response headers — the
+    body is deliberately NOT read (``_Answer``'s never-torn guarantee does
+    not apply): the relay forwards it chunk-by-chunk instead. Owns the
+    open upstream response AND the worker's inflight count until
+    ``close()``; closing with the body unread aborts the upstream
+    connection, which is exactly the worker's client-disconnect signal
+    (its engine cancels the slot and folds the capacity back in)."""
+
+    __slots__ = ("status", "content_type", "resp", "worker", "_state",
+                 "_closed")
+
+    def __init__(self, status: int, content_type: str, resp,
+                 worker: WorkerHandle, state: "RouterState") -> None:
+        self.status = status
+        self.content_type = content_type
+        self.resp = resp
+        self.worker = worker
+        self._state = state
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.resp.close()
+        self._state.supervisor.track_inflight(self.worker, -1)
+
+
 class RouterHandles:
     """Per-model hot-path metric handles, prebound once (PR 5 discipline)."""
 
     __slots__ = ("mcfg", "requests", "retries", "hedges", "timeouts",
-                 "latency", "peer_hops", "peer_errors", "peer_serves")
+                 "latency", "streams", "first_unit", "peer_hops",
+                 "peer_errors", "peer_serves")
 
     def __init__(self, name: str, mcfg, metrics: Metrics) -> None:
         self.mcfg = mcfg
@@ -149,6 +188,12 @@ class RouterHandles:
         self.hedges = metrics.counter(f"router_hedges_total{{model={name}}}")
         self.timeouts = metrics.counter(f"router_timeouts_total{{model={name}}}")
         self.latency = metrics.histogram(f"router_latency_ms{{model={name}}}")
+        # Streamed relays (ISSUE 17): committed streams forwarded, and the
+        # client-observed first-byte latency — the router-tier input for
+        # the "<model>:first_unit" SLO subject (queue + relay included).
+        self.streams = metrics.counter(f"router_streams_total{{model={name}}}")
+        self.first_unit = metrics.histogram(
+            f"router_first_unit_ms{{model={name}}}")
         # Sharded-cache peer hops (ISSUE 13): forwards to a key's owning
         # router, hops that failed transport (and degraded to local-only),
         # and requests this router served on a peer's behalf.
@@ -256,6 +301,11 @@ class RouterState:
         self._probe_at: dict[str, float] = {}
         self.draining = False
         self._inflight = 0
+        # Absolute instant (time.monotonic) after which in-flight STREAMS
+        # are terminated by their forward loops with a well-formed "drain"
+        # error event — set by drain(); None while serving (ISSUE 17: a
+        # long generation must not pin a drain to its full timeout).
+        self._stream_kill_at: float | None = None
         self.serving_addresses: list = []
         self._session: aiohttp.ClientSession | None = None
         # Tenant containment (ISSUE 16): resolve X-Api-Key once at ingress,
@@ -314,6 +364,19 @@ class RouterState:
                                          hooks=hooks)
             for mcfg in cfg.models:
                 self.slo.register(mcfg.name, mcfg.slo)
+                # First-token objective (ISSUE 17): a second SLO subject
+                # per streaming model, evaluated over the router's own
+                # first-byte histogram — the client-observed time-to-
+                # first-token, which is the latency a streaming UX is
+                # honestly judged at (total duration would be nonsense:
+                # long answers aren't slow answers).
+                if mcfg.slo is not None and mcfg.slo.first_unit_ms > 0:
+                    self.slo.register(
+                        f"{mcfg.name}:first_unit",
+                        SloConfig(latency_ms=mcfg.slo.first_unit_ms,
+                                  availability=mcfg.slo.availability,
+                                  burn_alert=mcfg.slo.burn_alert),
+                        metric=f"router_first_unit_ms{{model={mcfg.name}}}")
         self.fleet_scrapes = self.metrics.counter("fleet_scrapes_total")
         self.fleet_scrape_errors = self.metrics.counter(
             "fleet_scrape_errors_total")
@@ -541,6 +604,11 @@ class RouterState:
             await asyncio.get_running_loop().run_in_executor(
                 None, self.sampler.stop)
         self.begin_drain()
+        # Streams get a bounded budget of their own: after stream_drain_s
+        # every forward loop ends its stream with a "drain" error terminal
+        # (well-formed, never a silent truncation), so the inflight wait
+        # below converges even with long generations mid-flight.
+        self._stream_kill_at = time.monotonic() + self.rcfg.stream_drain_s
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         while self._inflight > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.02)
@@ -590,13 +658,25 @@ class RouterState:
     async def _attempt(self, w: WorkerHandle, name: str, verb: str,
                        body: bytes, ctype: str, deadline_at: float,
                        priority: str | None = None,
-                       ctx: "TraceContext | None" = None) -> _Answer:
+                       ctx: "TraceContext | None" = None,
+                       stream: bool = False,
+                       committed: "list[_StreamAnswer] | None" = None,
+                       ) -> "_Answer | _StreamAnswer":
         """One complete request/response against one worker. The body is
         fully read before returning, so a relayed response is never torn:
         a worker dying mid-body surfaces as a transport error (and a
         retry), not a truncated 200. ``priority`` relays the client's
         X-Priority so the worker's fleet scheduler arbitrates with the
         class the client asked for (header -> worker -> batcher).
+
+        With ``stream`` the client's ``?stream=true`` rides the forward,
+        and a worker answering with the stream header commits this attempt
+        at the HEADERS: the open response is handed up as a _StreamAnswer
+        (body unread — the forward loop relays it), which keeps the
+        worker's inflight count until the stream closes. Pre-commit
+        failures (connect refused, plain-status answers: a fast 504, a
+        429, a shed) still carry no body bytes, so the caller's retry and
+        hedge machinery stays legal for them.
 
         Trace propagation (ISSUE 12): the request's trace id crosses as
         ``X-Trace-Id`` and this attempt's pre-allocated span id as
@@ -620,27 +700,54 @@ class RouterState:
         self.supervisor.track_inflight(w, +1)
         w0 = time.time()
         outcome: "int | str" = "transport_error"
+        handed_off = False
         try:
-            async with self._session.post(
-                    f"{w.base_url}/v1/models/{name}:{verb}", data=body,
-                    headers=headers, timeout=timeout) as r:
+            r = await self._session.post(
+                f"{w.base_url}/v1/models/{name}:{verb}", data=body,
+                params={"stream": "true"} if stream else None,
+                headers=headers, timeout=timeout)
+            try:
+                if r.headers.get(_STREAM_HEADER) == "1":
+                    outcome = r.status
+                    handed_off = True
+                    sa = _StreamAnswer(
+                        r.status, r.content_type or "text/event-stream",
+                        r, w, self)
+                    if committed is not None:
+                        # Registered BEFORE this attempt can lose a race:
+                        # _relay's finally closes losers from this list
+                        # without touching task results.
+                        committed.append(sa)
+                    return sa
                 raw = await r.read()
                 outcome = r.status
                 return _Answer(r.status, r.content_type or "application/json",
                                raw, r.headers.get("Retry-After"))
+            finally:
+                if not handed_off:
+                    r.release()
         finally:
-            self.supervisor.track_inflight(w, -1)
+            if not handed_off:
+                self.supervisor.track_inflight(w, -1)
             if ctx is not None:
                 ctx.span("attempt", w0, time.time(), span_id=span_id,
-                         tid=name, worker=w.wid, status=outcome)
+                         tid=name, worker=w.wid, status=outcome,
+                         **({"streamed": True} if handed_off else {}))
 
     async def _relay(self, name: str, verb: str, body: bytes, ctype: str,
                      deadline_at: float,
                      priority: str | None = None,
-                     ctx: "TraceContext | None" = None) -> _Answer:
+                     ctx: "TraceContext | None" = None,
+                     stream: bool = False) -> "_Answer | _StreamAnswer":
         """Dispatch to the least-loaded healthy worker with retry + hedging
         under the absolute deadline. Returns the first definitive answer;
-        raises NoHealthyWorker / RelayDeadline / UpstreamFailed."""
+        raises NoHealthyWorker / RelayDeadline / UpstreamFailed.
+
+        A _StreamAnswer is definitive the instant it exists (the
+        first-byte latch): the loop returns it untouched, and the cleanup
+        below closes any LOSING stream commitments (a hedge that also
+        committed) — closing aborts the loser's upstream connection, which
+        the worker treats as a disconnect and reclaims the slot."""
         h = self.handles[name]
         tasks: dict[asyncio.Task, WorkerHandle] = {}
         tried: set[int] = set()
@@ -648,6 +755,8 @@ class RouterState:
         hedges_left = 1 if self.rcfg.hedge_ms > 0 else 0
         last_503: _Answer | None = None
         last_exc: Exception | None = None
+        committed: list[_StreamAnswer] = []
+        winner: _StreamAnswer | None = None
         loop = asyncio.get_running_loop()
 
         def remaining() -> float:
@@ -677,7 +786,7 @@ class RouterState:
             tried.add(w.wid)
             t = loop.create_task(
                 self._attempt(w, name, verb, body, ctype, deadline_at,
-                              priority, ctx))
+                              priority, ctx, stream, committed))
             tasks[t] = w
             return True
 
@@ -727,6 +836,8 @@ class RouterState:
                             # (200, 4xx, 500, 504). NEVER re-dispatched —
                             # a 500 already executed; re-running it would
                             # double-execute.
+                            if isinstance(ans, _StreamAnswer):
+                                winner = ans
                             return ans
                         # 503 = not admitted (worker draining / its own
                         # breaker): the work never ran, so another worker
@@ -756,7 +867,16 @@ class RouterState:
                     raise UpstreamFailed() from last_exc
         finally:
             for t in tasks:
-                t.cancel()
+                if not t.done():
+                    t.cancel()
+            # Any attempt that committed a stream but did not win — a
+            # hedge completing in the same wait() round as the winner, or
+            # outstanding when an error raised — must not leak its open
+            # upstream response (or the worker's inflight count). Losing
+            # streams registered themselves in `committed` at the headers.
+            for sa in committed:
+                if sa is not winner:
+                    sa.close()
 
     async def relay_cacheable(self, name: str, verb: str, body: bytes,
                               ctype: str, deadline_at: float,
@@ -771,6 +891,14 @@ class RouterState:
         if ans.status == 200:
             return (ans.content_type, ans.body)
         raise _RelayedError(ans)
+
+    def _count_stream_termination(self, name: str, reason: str) -> None:
+        """Tick router_stream_terminated_total{model=,reason=}. Created
+        on demand per reason — Metrics.counter dedups by full name, so
+        the handle is stable after the first tick."""
+        self.metrics.counter(
+            "router_stream_terminated_total"
+            f"{{model={name},reason={reason}}}").inc()
 
     def note_shed_reason(self, name: str, ans: _Answer) -> None:
         """Remember the machine-readable shed reason a worker answered
@@ -1131,7 +1259,12 @@ async def handle_predict(request: web.Request, verb: str) -> web.Response:
                   status=resp.status)
     if "X-Trace-Id" not in resp.headers:
         resp.headers["X-Trace-Id"] = ctx.trace_id
-    kinds = state.recorder.finish(ctx, name, resp.status, dur_s * 1e3)
+    # Streams score by first-byte latency + worst stall (stamped by the
+    # forward loop), not wall duration — a long generation is not slow.
+    score_ms = getattr(resp, "tpuserve_stream_score_ms", None)
+    kinds = state.recorder.finish(
+        ctx, name, resp.status,
+        score_ms if score_ms is not None else dur_s * 1e3)
     if state.events is not None:
         # Trace-correlated flight data (ISSUE 15): the single-process
         # discipline at the front door — errored/shed and retained-slow
@@ -1223,6 +1356,9 @@ async def _predict_relayed(request: web.Request, state: RouterState,
     ctype = request.content_type or ""
     try:
         timeout_ms = _requested_timeout_ms(request, body, ctype)
+        # Same validator as the worker's front door (ISSUE 17): a typo'd
+        # ?stream= flag 400s HERE, it never silently serves unary.
+        want_stream = _requested_stream(request)
     except ValueError as e:
         return _err(400, str(e), trace=ctx)
     timeout_s = (timeout_ms if timeout_ms is not None
@@ -1232,7 +1368,7 @@ async def _predict_relayed(request: web.Request, state: RouterState,
     state._inflight += 1
     try:
         ans = await _dispatch(state, name, verb, body, ctype, deadline_at,
-                              priority, ctx, tenant)
+                              priority, ctx, tenant, stream=want_stream)
     except NoHealthyWorker as e:
         breaker.record_failure()
         return _err(503, "no healthy worker; capacity respawning",
@@ -1248,6 +1384,22 @@ async def _predict_relayed(request: web.Request, state: RouterState,
                     retry_after=state.no_worker_retry_after(), trace=ctx)
     finally:
         state._inflight -= 1
+
+    if isinstance(ans, _StreamAnswer):
+        # The latch fired: the worker committed a stream. The breaker
+        # judged admission; total-duration latency would poison the
+        # router_latency_ms SLO (long answers are not slow answers), so
+        # streams score first-byte + worst stall inside the forward
+        # instead. No await between the decrement above and this
+        # re-increment, so drain's inflight poll can never observe the
+        # stream missing.
+        breaker.record_success()
+        state._inflight += 1
+        try:
+            return await _forward_stream(request, state, name, h, ans, ctx,
+                                         tenant, t_start, deadline_at)
+        finally:
+            state._inflight -= 1
 
     if ans.status == 200:
         breaker.record_success()
@@ -1265,11 +1417,160 @@ async def _predict_relayed(request: web.Request, state: RouterState,
     return ans.to_response()
 
 
+def _stream_error_bytes(content_type: str, reason: str,
+                        message: str) -> bytes:
+    """A well-formed error terminal in the stream's own wire format — what
+    the router appends when the worker no longer can (ISSUE 17: a torn
+    stream must end in a terminal event naming its cause, never a silent
+    truncation). Binary streams get a KIND_EVENT frame, everything else
+    the SSE error event, matching the worker's own terminal encoding."""
+    data = {"error": reason, "message": message}
+    if content_type == frame.CONTENT_TYPE:
+        payload = json.dumps({"type": "error", **data})
+        return frame.encode_stream_event(payload.encode("utf-8"))
+    return (f"event: error\ndata: {json.dumps(data)}\n\n").encode("utf-8")
+
+
+async def _forward_stream(request: web.Request, state: RouterState,
+                          name: str, h: RouterHandles, ans: _StreamAnswer,
+                          ctx: TraceContext, tenant: str | None,
+                          t_start: float,
+                          deadline_at: float) -> web.StreamResponse:
+    """Bidirectional relay of one committed stream (ISSUE 17 tentpole).
+
+    The first-byte latch has fired — _StreamAnswer is definitive — so from
+    here every failure ends the CLIENT's stream with a well-formed error
+    terminal and never a re-dispatch (replaying a new attempt's tokens
+    after bytes reached the client would corrupt its transcript):
+
+    - worker death mid-stream (SIGKILL, crash): the chunked upstream read
+      raises -> "upstream_error" terminal + transport-failure note (the
+      host breaker routes around the corpse) + breaker failure;
+    - a stall past [router] stream_idle_timeout_ms with no bytes (the
+      worker's heartbeats normally cover idle generation gaps) ->
+      "idle_timeout" or, past the absolute deadline, "deadline_exceeded";
+    - router drain past its stream budget -> "drain" terminal;
+    - client disconnect: the upstream close IS the worker's signal to
+      cancel the slot and fold the capacity back in.
+    """
+    h.streams.inc()
+    w = ans.worker
+    resp = web.StreamResponse(status=ans.status)
+    resp.content_type = ans.content_type
+    resp.headers[_STREAM_HEADER] = "1"
+    resp.headers["X-Trace-Id"] = ctx.trace_id
+    idle_s = state.rcfg.stream_idle_timeout_ms / 1e3
+    first_unit_ms: float | None = None
+    last_chunk: float | None = None
+    max_gap_ms = 0.0
+    reason = "done"
+    failure: str | None = None  # != None -> append our own error terminal
+    bytes_out = 0
+    w0 = time.time()
+    try:
+        try:
+            await resp.prepare(request)
+        except (ConnectionResetError, ConnectionError):
+            reason = "client_disconnect"
+        else:
+            it = ans.resp.content.iter_any()
+            while True:
+                if state._stream_kill_at is not None \
+                        and time.monotonic() >= state._stream_kill_at:
+                    reason = "drain"
+                    failure = "router draining; stream budget spent"
+                    break
+                wait_s = idle_s if idle_s > 0 else None
+                if state._stream_kill_at is not None:
+                    till_kill = max(0.0,
+                                    state._stream_kill_at - time.monotonic())
+                    wait_s = till_kill if wait_s is None \
+                        else min(wait_s, till_kill)
+                try:
+                    chunk = await asyncio.wait_for(it.__anext__(),
+                                                   timeout=wait_s)
+                except StopAsyncIteration:
+                    # Clean upstream EOF: the worker authored the terminal
+                    # (done or error) as its last bytes — already relayed.
+                    break
+                except asyncio.TimeoutError:
+                    if state._stream_kill_at is not None \
+                            and time.monotonic() >= state._stream_kill_at:
+                        continue  # the drain check at the loop top fires
+                    if deadline_at - time.perf_counter() <= 0:
+                        reason = "deadline_exceeded"
+                        failure = "absolute deadline exceeded mid-stream"
+                    else:
+                        reason = "idle_timeout"
+                        failure = (f"no bytes from worker {w.wid} for "
+                                   f"{idle_s:g}s")
+                    state.supervisor.note_transport_failure(w)
+                    state.breakers[name].record_failure()
+                    break
+                except (aiohttp.ClientError, OSError) as e:
+                    reason = "upstream_error"
+                    failure = f"worker {w.wid} died mid-stream: {e}"
+                    state.supervisor.note_transport_failure(w)
+                    state.breakers[name].record_failure()
+                    break
+                now = time.perf_counter()
+                if first_unit_ms is None:
+                    first_unit_ms = (now - t_start) * 1e3
+                    h.first_unit.observe(first_unit_ms,
+                                         trace_id=ctx.trace_id)
+                elif last_chunk is not None:
+                    max_gap_ms = max(max_gap_ms, (now - last_chunk) * 1e3)
+                last_chunk = now
+                bytes_out += len(chunk)
+                try:
+                    await resp.write(chunk)
+                except (ConnectionResetError, ConnectionError):
+                    reason, failure = "client_disconnect", None
+                    break
+            if failure is not None:
+                try:
+                    await resp.write(_stream_error_bytes(
+                        ans.content_type, reason, failure))
+                except (ConnectionResetError, ConnectionError):
+                    pass
+    finally:
+        # Closing the upstream (body possibly unread) is the worker's
+        # disconnect signal: its engine cancels the slot. Also releases
+        # the worker's inflight count held since the latch.
+        ans.close()
+    state._count_stream_termination(name, reason)
+    ctx.span("stream_relay", w0, time.time(), tid=name, worker=w.wid,
+             reason=reason, bytes=bytes_out,
+             first_unit_ms=round(first_unit_ms, 3)
+             if first_unit_ms is not None else None,
+             max_gap_ms=round(max_gap_ms, 3))
+    if state.events is not None and reason != "done":
+        state.events.emit(
+            "warning", "router", "stream_terminated", model=name,
+            trace_id=ctx.trace_id, reason=reason, worker=w.wid,
+            bytes=bytes_out)
+    # Recorder scoring (handle_predict): a stream's health is its
+    # first-byte latency and worst stall, not its total duration.
+    resp.tpuserve_stream_score_ms = max(first_unit_ms or 0.0, max_gap_ms)
+    if state.tenants is not None and tenant is not None:
+        dur_s = time.perf_counter() - t_start
+        state.tenants.record(
+            tenant, dur_s,
+            latency_ms=first_unit_ms if first_unit_ms is not None
+            else dur_s * 1e3)
+    try:
+        await resp.write_eof()
+    except (ConnectionResetError, ConnectionError):
+        pass
+    return resp
+
+
 async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
                     ctype: str, deadline_at: float,
                     priority: str | None = None,
                     ctx: "TraceContext | None" = None,
-                    tenant: str | None = None) -> _Answer:
+                    tenant: str | None = None,
+                    stream: bool = False) -> "_Answer | _StreamAnswer":
     """Cache/single-flight front of the relay (router-owned PR-5 layer),
     sharded across the router tier (ISSUE 13).
 
@@ -1285,9 +1586,14 @@ async def _dispatch(state: RouterState, name: str, verb: str, body: bytes,
     semantics hold across routers. An unreachable owner degrades to the
     local path (counted), never to an error."""
     cache = state.caches.get(name)
-    if cache is None:
+    if cache is None or stream:
+        # Streams bypass EVERY cache tier — local shard, single-flight
+        # coalescing, and the peer-forward hop (ISSUE 17): a stream is a
+        # live connection, not a cacheable byte answer, and coalescing a
+        # stream under another request's flight would hand one client's
+        # tokens to another.
         return await state._relay(name, verb, body, ctype, deadline_at,
-                                  priority, ctx)
+                                  priority, ctx, stream=stream)
     key = cache.key_for((verb, ctype, body))
     if state.ring is not None:
         owner = state.ring.owner(key)
