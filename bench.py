@@ -526,9 +526,184 @@ def main_generative(bench_model: str) -> int:
     return 0 if line["n_err"] == 0 and line["value"] > 0 else 1
 
 
+async def _run_stream_load(cfg, model: str, duration: float, warmup: float,
+                           concurrency: int, distinct: int, max_new_hi: int,
+                           long_every: int = 0, long_words: int = 16) -> dict:
+    """Out-of-process STREAMING prompt load; ``long_every`` > 0 skews the
+    pool with max-length prompts (the paged-KV workload)."""
+    args = [
+        sys.executable, "-m", "tpuserve", "bench",
+        "--url", f"http://{cfg.host}:{cfg.port}",
+        "--model", model, "--verb", "generate", "--stream",
+        "--duration", str(duration), "--warmup", str(warmup),
+        "--concurrency", str(concurrency),
+        "--content-type", "application/json",
+        "--distinct", str(distinct), "--synthetic", "prompt",
+        "--max-new", f"2,{max_new_hi}",
+        "--long-every", str(long_every), "--long-words", str(long_words),
+    ]
+    proc = await asyncio.create_subprocess_exec(
+        *args, stdout=asyncio.subprocess.PIPE,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    out, _ = await proc.communicate()
+    return json.loads(out.decode())
+
+
+def main_paged_kv() -> int:
+    """BENCH_KV_PAGING=1 (textgen): the long-context paged-KV headline
+    (ISSUE 18). Three passes:
+
+    1. **paged / unloaded** — streaming load over a uniform short-prompt
+       pool: the baseline inter-token gap distribution.
+    2. **paged / loaded** — the SAME rate of shorts with a max-length
+       prompt injected every BENCH_KV_LONG_EVERY bodies, so chunked
+       prefills continuously interleave with decode. The headline
+       tokens/s comes from this pass, and ``gap_p99_loaded_vs_unloaded``
+       is the flatness ratio the smoke gates on.
+    3. **dense comparison** — kv_paging off, same skewed pool:
+       ``paged_vs_dense_tokens_s`` is the end-to-end win (or cost) of
+       paging at this geometry.
+
+    The JSON adds ``max_concurrent_slots`` (peak simultaneously-active
+    slots, server-side) and ``kv_bytes_per_slot`` (device KV bytes over
+    that peak) — the capacity claim paging exists for."""
+    import jax
+
+    from tpuserve.config import GenserveConfig, ServerConfig
+    from tpuserve.server import ServerState, make_app
+
+    t_all = time.time()
+    duration = env_f("BENCH_DURATION", 20)
+    warmup = env_f("BENCH_WARMUP", 4)
+    concurrency = int(env_f("BENCH_CONCURRENCY", 16))
+    distinct = int(env_f("BENCH_DISTINCT", 64))
+    slots = int(env_f("BENCH_GEN_SLOTS", 8))
+    page_tokens = int(env_f("BENCH_KV_PAGE_TOKENS", 16))
+    prefill_chunk = int(env_f("BENCH_KV_CHUNK", 8))
+    long_every = int(env_f("BENCH_KV_LONG_EVERY", 4))
+    mcfg = _gen_model_config("textgen")
+    max_new_hi = int(mcfg.options.get("max_new_tokens", 64))
+    long_words = int(mcfg.options.get("prompt_len", 32))
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jaxcache")
+
+    async def serve(paged: bool):
+        from aiohttp import web
+
+        cfg = ServerConfig(
+            host="127.0.0.1", port=int(os.environ.get("BENCH_PORT", 18321)),
+            decode_threads=4, startup_canary=False,
+            decode_inline=bool(int(os.environ.get("BENCH_DECODE_INLINE",
+                                                  "1"))),
+            compilation_cache_dir=cache_dir,
+            genserve=GenserveConfig(
+                enabled=True, slots=slots, kv_paging=paged,
+                kv_page_tokens=page_tokens,
+                prefill_chunk=prefill_chunk if paged else 0),
+            models=[_gen_model_config("textgen")])
+        state = ServerState(cfg)
+        t0 = time.time()
+        state.build()
+        print(f"# {'paged' if paged else 'dense'} build took "
+              f"{time.time() - t0:.1f}s", file=sys.stderr)
+        runner = web.AppRunner(make_app(state), access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, cfg.host, cfg.port)
+        await site.start()
+        return cfg, state, runner
+
+    async def run() -> dict:
+        cfg, state, runner = await serve(paged=True)
+        try:
+            unloaded = await _run_stream_load(
+                cfg, "textgen", duration, warmup, concurrency, distinct,
+                max_new_hi)
+            loaded = await _run_stream_load(
+                cfg, "textgen", duration, warmup, concurrency, distinct,
+                max_new_hi, long_every=long_every, long_words=long_words)
+            gs = state.engines["textgen"].pipeline_stats()
+            print_breakdown(state, "paged")
+        finally:
+            await runner.cleanup()
+
+        dense_tokens_s = None
+        if int(env_f("BENCH_GEN_BASELINE", 1)):
+            cfg, state, runner = await serve(paged=False)
+            try:
+                dense = await _run_stream_load(
+                    cfg, "textgen", duration, warmup, concurrency, distinct,
+                    max_new_hi, long_every=long_every,
+                    long_words=long_words)
+                dense_tokens_s = dense["tokens_per_s"]
+                print_breakdown(state, "dense")
+            finally:
+                await runner.cleanup()
+
+        peak = int(gs.get("peak_active", 0))
+        kv_bytes = int(gs.get("kv", {}).get("kv_bytes", 0))
+        u99, l99 = (unloaded["inter_token_gap_p99_ms"],
+                    loaded["inter_token_gap_p99_ms"])
+
+        def gap_block(s: dict) -> dict:
+            return {k: s[k] for k in
+                    ("inter_token_gap_p50_ms", "inter_token_gap_p99_ms",
+                     "inter_token_gap_max_ms", "inter_token_gap_hist_ms",
+                     "tokens_per_s", "first_token_p50_ms", "n_ok", "n_err",
+                     "torn_streams")}
+
+        line = {
+            "metric": "pagedkv_tokens_s",
+            "value": loaded["tokens_per_s"],
+            "unit": "tok/s",
+            "max_concurrent_slots": peak,
+            "kv_bytes_per_slot": round(kv_bytes / peak) if peak else None,
+            "unloaded": gap_block(unloaded),
+            "loaded": gap_block(loaded),
+            "gap_p99_loaded_vs_unloaded": round(l99 / u99, 3)
+            if u99 else None,
+            "paged_vs_dense_tokens_s": round(
+                loaded["tokens_per_s"] / dense_tokens_s, 3)
+            if dense_tokens_s else None,
+            "dense_tokens_s": dense_tokens_s,
+            "genserve": {
+                "slots": slots,
+                "kv": gs.get("kv"),
+                "iterations_total": gs["iterations_total"],
+                "fold_ins_total": gs["fold_ins_total"],
+            },
+            "backend": {
+                "platform": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "jax_version": jax.__version__,
+            },
+            "config": {"model": "textgen", "duration_s": duration,
+                       "concurrency": concurrency,
+                       "page_tokens": page_tokens,
+                       "prefill_chunk": prefill_chunk,
+                       "long_every": long_every,
+                       "options": dict(mcfg.options)},
+            "wall_s": round(time.time() - t_all, 1),
+        }
+        return line
+
+    line = asyncio.run(run())
+    print(json.dumps(line))
+    ok = (line["value"] > 0
+          and line["loaded"]["torn_streams"] == 0
+          and line["unloaded"]["torn_streams"] == 0)
+    return 0 if ok else 1
+
+
 def main() -> int:
     t_all = time.time()
     bench_model = os.environ.get("BENCH_MODEL", "")
+    if int(env_f("BENCH_KV_PAGING", 0)):
+        if bench_model not in ("", "textgen"):
+            print(f"# BENCH_KV_PAGING needs BENCH_MODEL=textgen, "
+                  f"got {bench_model!r}", file=sys.stderr)
+            return 2
+        return main_paged_kv()
     if bench_model:
         if bench_model not in ("textgen", "sd15"):
             print(f"# unknown BENCH_MODEL={bench_model!r}; "

@@ -35,6 +35,9 @@ scripts/roofline_smoke.sh
 echo "== genserve smoke (mixed-length load, early exits + fold-ins, compile delta 0) =="
 scripts/genserve_smoke.sh
 
+echo "== pagedkv smoke (slot-count win at fixed KV memory, flat gap p99 under chunked prefill, compile delta 0) =="
+scripts/pagedkv_smoke.sh
+
 echo "== ingest smoke (framed wire, 3 accept loops balanced, compile delta 0) =="
 scripts/ingest_smoke.sh
 

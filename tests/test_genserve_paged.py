@@ -1,0 +1,420 @@
+"""Paged KV cache + chunked prefill (ISSUE 18): page-ledger safety,
+paged==dense token parity, zero recompiles across page/slot churn and
+reload, chunked-prefill determinism and non-starvation, page-pressure
+admission over HTTP, and the fleet predictor's kv term.
+docs/PERFORMANCE.md "Paged KV & chunked prefill"."""
+
+import asyncio
+import json
+
+import pytest
+
+from tpuserve.config import GenserveConfig, ModelConfig, ServerConfig
+from tpuserve.genserve import (GenEngine, KVPressure, PageCorrupted,
+                               PageLedger)
+from tpuserve.models import build
+from tpuserve.obs import Metrics
+from tpuserve.runtime import build_runtime
+
+TG_OPTS = dict(layers=1, d_model=32, heads=2, d_ff=64, vocab_size=512,
+               prompt_len=16, max_new_tokens=64)
+
+
+def tg_cfg(**over) -> ModelConfig:
+    base = dict(name="tg", family="textgen", batch_buckets=[1, 2, 4],
+                dtype="float32", parallelism="single", max_queue=64,
+                request_timeout_ms=60_000.0, options=dict(TG_OPTS))
+    base.update(over)
+    return ModelConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def dense_rt():
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    GenEngine(model, rt, Metrics(), GenserveConfig(slots=4)).compile()
+    return model, rt
+
+
+@pytest.fixture(scope="module")
+def paged_rt():
+    """Same model config as dense_rt (identical deterministic params), own
+    runtime because the paged geometry registers different programs."""
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    GenEngine(model, rt, Metrics(), GenserveConfig(
+        slots=4, kv_paging=True, kv_page_tokens=8)).compile()
+    return model, rt
+
+
+@pytest.fixture(scope="module")
+def chunked_rt():
+    """prefill_chunk=4 is a different geometry again (its prefill program
+    closes over the chunk width)."""
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    GenEngine(model, rt, Metrics(), GenserveConfig(
+        slots=4, kv_paging=True, kv_page_tokens=8, prefill_chunk=4)).compile()
+    return model, rt
+
+
+def make_engine(fix, metrics=None, slots=4, **gc_over):
+    model, rt = fix
+    m = metrics or Metrics()
+    eng = GenEngine(model, rt, m, GenserveConfig(slots=slots, **gc_over))
+    eng.compile()  # reuses the runtime's registered programs
+    return eng, m
+
+
+def paged_over(**over):
+    base = dict(kv_paging=True, kv_page_tokens=8)
+    base.update(over)
+    return base
+
+
+def prompt_item(model, prompt="hello world", seed=0, max_new=8, temp=0.0):
+    body = {"prompt": prompt, "seed": seed, "max_new_tokens": max_new}
+    if temp:
+        body["temperature"] = temp
+    return model.host_decode(json.dumps(body).encode(), "application/json")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------------------
+# PageLedger: never double-hands
+# ---------------------------------------------------------------------------
+
+def test_page_ledger_never_double_hands():
+    led = PageLedger(4, 8)  # sentinel + 3 usable
+    assert led.usable == 3 and led.n_free == 3
+    a = led.acquire(0, 2)
+    assert a == [1, 2] and PageLedger.SENTINEL not in a
+    b = led.acquire(1, 1)
+    assert b == [3] and led.n_free == 0
+    with pytest.raises(IndexError):
+        led.acquire(2, 1)  # pool exhausted
+    with pytest.raises(PageCorrupted):
+        led.acquire(0, 1)  # slot 0 already holds pages
+    assert led.release(0) == [1, 2]
+    with pytest.raises(PageCorrupted):
+        led.release(0)  # double release
+    with pytest.raises(PageCorrupted):
+        led.release(7)  # foreign release: slot never held pages
+    # A tampered free-list (owned page re-listed) is caught at acquire.
+    led._free.append(3)
+    with pytest.raises(PageCorrupted):
+        led.acquire(5, 1)
+
+
+def test_page_ledger_release_all_and_stats():
+    led = PageLedger(6, 16)
+    led.acquire(0, 2)
+    led.acquire(1, 3)
+    s = led.stats()
+    assert s["usable"] == 5 and s["reserved"] == 5 and s["free"] == 0
+    assert s["utilization"] == 1.0 and s["acquires_total"] == 5
+    assert led.release_all() == 5
+    assert led.n_free == led.usable and led.n_reserved == 0
+    assert led.utilization() == 0.0
+    with pytest.raises(ValueError):
+        PageLedger(1, 8)  # no room for the sentinel + one real page
+    with pytest.raises(ValueError):
+        PageLedger(4, 0)
+
+
+def test_kv_config_validation(paged_rt):
+    with pytest.raises(ValueError, match="kv_pages"):
+        GenserveConfig(kv_pages=1)
+    with pytest.raises(ValueError, match="kv_page_tokens"):
+        GenserveConfig(kv_page_tokens=0)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        GenserveConfig(prefill_chunk=-1)
+    # A pool that cannot cover even ONE max-context request rejects at
+    # engine construction (pps=ceil(80/8)=10, so 11 is the floor).
+    model, rt = paged_rt
+    with pytest.raises(ValueError, match="cover"):
+        GenEngine(model, rt, Metrics(),
+                  GenserveConfig(slots=4, **paged_over(kv_pages=5)))
+
+
+# ---------------------------------------------------------------------------
+# Parity: the tentpole acceptance bar
+# ---------------------------------------------------------------------------
+
+def test_paged_matches_dense_token_identical(dense_rt, paged_rt):
+    """Default (whole-prompt) paged prefill routes through the SAME dense
+    init_state math and the paged decode computes the same attention through
+    the block table — tokens must be byte-identical, not approximately
+    equal, over mixed lengths / seeds / temperatures."""
+    d_model, _ = dense_rt
+    p_model, _ = paged_rt
+    d_eng, _ = make_engine(dense_rt)
+    p_eng, _ = make_engine(paged_rt, **paged_over())
+
+    prompts = [
+        ("a", 1, 3, 0.0),
+        ("the quick brown fox jumps over the lazy dog again and again", 2,
+         12, 0.7),
+        ("short prompt", 3, 1, 0.0),
+        ("one two three four five six seven eight nine ten eleven twelve "
+         "thirteen fourteen fifteen sixteen", 4, 8, 0.3),
+        ("hello", 5, 20, 1.0),
+        ("mid size prompt with a few words", 6, 5, 0.0),
+    ]
+
+    async def drive(eng, model):
+        await eng.start()
+        futs = [eng.submit(prompt_item(model, p, seed=s, max_new=n, temp=t))
+                for (p, s, n, t) in prompts]
+        res = await asyncio.gather(*futs)
+        await eng.stop()
+        return [r["tokens"] for r in res]
+
+    dense = run(drive(d_eng, d_model))
+    paged = run(drive(p_eng, p_model))
+    assert dense == paged, (dense, paged)
+    # The ledger balanced after the drain — every page came home.
+    assert p_eng.pages.n_free == p_eng.pages.usable
+    assert p_eng.pages.n_reserved == 0
+
+
+def test_paged_zero_recompiles_across_churn_and_reload(paged_rt):
+    """Page churn + slot churn + a publish AND a rollback mid-churn with
+    runtime_compiles_total delta exactly 0: page indices and block-table
+    rows are traced arguments, never baked into the program."""
+    model, rt = paged_rt
+    eng, _m = make_engine(paged_rt, **paged_over())
+    c0 = rt.compiles_total
+    assert c0 >= 3  # prefill/step/extract registered
+
+    async def go():
+        await eng.start()
+        futs = [eng.submit(prompt_item(model, f"p{i} " + "w " * (i % 13),
+                                       seed=i, max_new=1 + (i % 9)))
+                for i in range(8)]
+        rt.publish(rt.stage_params())  # reload mid-churn
+        futs += [eng.submit(prompt_item(model, f"q{i}", seed=100 + i,
+                                        max_new=2 + (i % 5)))
+                 for i in range(8)]
+        rt.rollback()
+        futs += [eng.submit(prompt_item(model, f"r{i}", seed=200 + i,
+                                        max_new=3)) for i in range(4)]
+        res = await asyncio.gather(*futs)
+        await eng.stop()
+        return res
+
+    res = run(go())
+    assert len(res) == 20 and all(r["n_tokens"] >= 1 for r in res)
+    assert rt.compiles_total == c0, (rt.compiles_total, c0)
+    # Slot AND page accounting survived the churn exactly.
+    assert eng.arena.n_active == 0 and eng.arena.n_free == eng.slots
+    assert eng.pages.n_reserved == 0
+    assert eng.pages.n_free == eng.pages.usable
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill
+# ---------------------------------------------------------------------------
+
+LONG16 = ("one two three four five six seven eight nine ten eleven twelve "
+          "thirteen fourteen fifteen sixteen")
+
+
+def test_chunked_prefill_deterministic_under_load(chunked_rt):
+    """A max-length prompt prefilled in 4-token chunks emits the same
+    tokens alone and amid decode load — chunk boundaries are fixed by the
+    prompt, not by what else occupies the batch."""
+    model, _ = chunked_rt
+    e_alone, _ = make_engine(chunked_rt, **paged_over(prefill_chunk=4))
+    e_load, _ = make_engine(chunked_rt, **paged_over(prefill_chunk=4))
+
+    async def alone():
+        await e_alone.start()
+        r = await e_alone.submit(
+            prompt_item(model, LONG16, seed=9, max_new=8, temp=0.5))
+        await e_alone.stop()
+        return r["tokens"]
+
+    async def amid_load():
+        await e_load.start()
+        futs = [e_load.submit(prompt_item(model, "short one", seed=i + 1,
+                                          max_new=3)) for i in range(3)]
+        long_f = e_load.submit(
+            prompt_item(model, LONG16, seed=9, max_new=8, temp=0.5))
+        futs += [e_load.submit(prompt_item(model, "another short",
+                                           seed=i + 10, max_new=4))
+                 for i in range(3)]
+        out = await asyncio.gather(long_f, *futs)
+        await e_load.stop()
+        return out[0]["tokens"]
+
+    assert run(alone()) == run(amid_load())
+    assert e_alone.pages.n_reserved == 0 and e_load.pages.n_reserved == 0
+
+
+def test_chunked_prefill_never_starves_decode(chunked_rt):
+    """THE interleaving property: short decodes admitted alongside a
+    max-length prompt all complete while the long one is still working —
+    prefill advances one chunk per engine iteration instead of stalling
+    the step loop for the whole prompt."""
+    model, _ = chunked_rt
+    eng, m = make_engine(chunked_rt, **paged_over(prefill_chunk=4))
+
+    async def go():
+        await eng.start()
+        order = []
+        # 16-token prompt -> 4 prefill chunks + 8 decode steps.
+        long_f = eng.submit(prompt_item(model, LONG16, seed=1, max_new=8))
+        long_f.add_done_callback(lambda f: order.append("long"))
+        shorts = []
+        for i in range(3):
+            f = eng.submit(prompt_item(model, "hi", seed=10 + i, max_new=2))
+            f.add_done_callback(lambda f, i=i: order.append(f"s{i}"))
+            shorts.append(f)
+        await asyncio.gather(long_f, *shorts)
+        await eng.stop()
+        return order
+
+    order = run(go())
+    assert order[-1] == "long", order  # every short finished first
+    assert set(order[:-1]) == {"s0", "s1", "s2"}
+    # 4 chunks for the long prompt + 1 whole-prompt chunk per short.
+    assert m.counter(
+        "gen_prefill_chunks_total{model=tg}").value == pytest.approx(7)
+
+
+# ---------------------------------------------------------------------------
+# Page-pressure admission
+# ---------------------------------------------------------------------------
+
+def test_kv_pressure_sheds_beyond_backlog_bound():
+    """Projected demand beyond one pool turnover of backlog sheds with
+    KVPressure (a QueueFull subclass: existing handling still works), and
+    the kv_pressure shed reason is counted."""
+    # Own runtime: the pool size is part of the compiled state shape.
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    m = Metrics()
+    eng = GenEngine(model, rt, m, GenserveConfig(
+        slots=4, **paged_over(kv_pages=11)))  # 10 usable, bound 20
+    eng.compile()
+
+    async def go():
+        await eng.start()
+        # Each needs ceil((4 + 60) / 8) = 8 pages.
+        item = lambda s: prompt_item(model, "hold the pool please",
+                                     seed=s, max_new=60)
+        f1, f2 = eng.submit(item(1)), eng.submit(item(2))
+        with pytest.raises(KVPressure):
+            eng.submit(item(3))  # projected 24 > 20
+        await asyncio.gather(f1, f2)
+        await eng.stop()
+
+    run(go())
+    assert m.counter(
+        "sched_sheds_total{model=tg,reason=kv_pressure}").value == 1
+    assert eng.pages.n_reserved == 0
+
+
+def test_kv_clear_s_and_fleet_predictor():
+    """kv_clear_s: None while the pool is comfortable, a positive
+    clear-time once pressure + evidence exist; the fleet predictor folds
+    it in even with an empty queue."""
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    eng = GenEngine(model, rt, Metrics(), GenserveConfig(
+        slots=4, **paged_over()))
+    eng.compile()
+    assert eng.kv_clear_s() is None  # comfortable pool, no evidence
+    eng._ewma_step_ms = 10.0
+    eng._ewma_iters = 5.0
+    eng._ewma_pages = float(eng.pages.usable + 1)  # n_free < typical need
+    assert eng.kv_clear_s() == pytest.approx(0.05)
+
+    from tpuserve.config import SchedulerConfig
+    from tpuserve.scheduler.fleet import FleetScheduler
+
+    class StubPaged:
+        device_time_cb = None
+
+        def estimate_clear_s(self):
+            return None  # empty queue
+
+        def kv_clear_s(self):
+            return 1.5
+
+        def predicted_service_s(self, n_items=1):
+            return 0.5
+
+    sched = FleetScheduler(SchedulerConfig(enabled=True), Metrics())
+    sched.register("m", StubPaged(), tg_cfg(name="m"))
+    assert sched.predict_completion_s("m") == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front door: 503 + Retry-After + observability
+# ---------------------------------------------------------------------------
+
+def test_http_kv_pressure_503_and_stats():
+    from aiohttp.test_utils import TestClient, TestServer
+    from tpuserve.server import ServerState, make_app
+
+    cfg = ServerConfig(
+        decode_threads=2,
+        genserve=GenserveConfig(enabled=True, slots=4, kv_paging=True,
+                                kv_page_tokens=8, kv_pages=11),
+        models=[tg_cfg()])
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            # Warm one request to completion: establishes the step/iters
+            # EWMAs that price the Retry-After hint.
+            warm = await client.post(
+                "/v1/models/tg:generate",
+                data=json.dumps({"prompt": "warm", "seed": 1,
+                                 "max_new_tokens": 2}),
+                headers={"Content-Type": "application/json"})
+            assert warm.status == 200, await warm.text()
+            # Saturate the pool (10 usable, backlog bound 20) with two
+            # 8-page reservations queued engine-side, then the third over
+            # HTTP sheds BEFORE enqueue.
+            eng = state.batchers["tg"]
+            body = lambda s: json.dumps({"prompt": "hold the pool please",
+                                         "seed": s, "max_new_tokens": 60})
+            item = lambda s: eng.model.host_decode(body(s).encode(),
+                                                   "application/json")
+            f1, f2 = eng.submit(item(1)), eng.submit(item(2))
+            shed = await client.post(
+                "/v1/models/tg:generate", data=body(3),
+                headers={"Content-Type": "application/json"})
+            assert shed.status == 503, await shed.text()
+            payload = await shed.json()
+            assert payload["reason"] == "kv_pressure"
+            assert int(shed.headers["Retry-After"]) >= 1
+            # /stats carries the kv block; /metrics the page gauges.
+            stats = await (await client.get("/stats")).json()
+            kv = stats["genserve"]["tg"]["kv"]
+            assert kv["pages"] == 11 and kv["page_tokens"] == 8
+            assert kv["kv_bytes"] > 0
+            metrics = await (await client.get("/metrics")).text()
+            assert 'gen_kv_pages_total{model="tg"}' in metrics
+            assert 'gen_kv_pages_free{model="tg"}' in metrics
+            assert 'gen_kv_page_utilization{model="tg"}' in metrics
+            assert ('sched_sheds_total{model="tg",reason="kv_pressure"}'
+                    in metrics)
+            await asyncio.gather(f1, f2)
+        finally:
+            await client.close()
+
+    run(go())

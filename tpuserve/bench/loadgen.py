@@ -35,6 +35,28 @@ import numpy as np
 
 from tpuserve.obs import percentile
 
+# Inter-token gap histogram edges (ms). Log-ish spacing: the interesting
+# signal is the tail (a prefill stall parks every decoder for one chunk),
+# and a fixed ladder keeps pass-over-pass summaries comparable.
+GAP_HIST_EDGES_MS = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0)
+
+
+def gap_histogram(gaps_ms: list[float]) -> dict:
+    """Fixed-ladder histogram of inter-token gaps: ``{"<=10": n, ...,
+    ">250": n}`` — cheap to eyeball across loadgen passes."""
+    counts = [0] * (len(GAP_HIST_EDGES_MS) + 1)
+    for g in gaps_ms:
+        for i, edge in enumerate(GAP_HIST_EDGES_MS):
+            if g <= edge:
+                counts[i] += 1
+                break
+        else:
+            counts[-1] += 1
+    out = {f"<={edge:g}": counts[i]
+           for i, edge in enumerate(GAP_HIST_EDGES_MS)}
+    out[f">{GAP_HIST_EDGES_MS[-1]:g}"] = counts[-1]
+    return out
+
 
 @dataclass
 class LoadResult:
@@ -113,7 +135,11 @@ class StreamLoadResult:
                 percentile(self.first_token_ms, 0.5), 3),
             "first_token_p99_ms": round(
                 percentile(self.first_token_ms, 0.99), 3),
+            "inter_token_gap_p50_ms": round(percentile(self.gap_ms, 0.5), 3),
             "inter_token_gap_p99_ms": round(percentile(self.gap_ms, 0.99), 3),
+            "inter_token_gap_max_ms": round(max(self.gap_ms), 3)
+            if self.gap_ms else 0.0,
+            "inter_token_gap_hist_ms": gap_histogram(self.gap_ms),
             "terminals": dict(self.terminals),
             "torn_streams": self.torn,
         }
@@ -364,7 +390,9 @@ def synthetic_frame_pool(n: int, edge: int = 256, n_items: int = 8,
 
 
 def synthetic_prompt_pool(n: int, max_new: tuple[int, int] = (2, 32),
-                          sd: bool = False, seed: int = 0) -> list[bytes]:
+                          sd: bool = False, seed: int = 0,
+                          long_every: int = 0,
+                          long_words: int = 16) -> list[bytes]:
     """``n`` distinct JSON prompt bodies for the generative families.
 
     Every body carries a distinct (prompt, seed) pair — the generative
@@ -374,7 +402,13 @@ def synthetic_prompt_pool(n: int, max_new: tuple[int, int] = (2, 32),
     (ISSUE 9): a locked batch runs every lane for its longest member, so
     the iteration-level engine's early-exit gain is only visible when
     short and long completions share a batch. SD bodies (``sd=True``) omit
-    the length knob (fixed denoise steps) and vary prompt + seed only."""
+    the length knob (fixed denoise steps) and vary prompt + seed only.
+
+    ``long_every`` > 0 SKEWS the pool (ISSUE 18): every long_every-th body
+    carries a ``long_words``-word prompt (a max-length prefill for the
+    default textgen bench geometry) at the top of the max_new range — the
+    workload that exposes prefill stalls and KV-footprint ceilings that a
+    uniformly short pool never touches."""
     rng = np.random.default_rng(seed)
     words = ("fast serve model token image chip batch fox sky ocean "
              "mountain river night day glass stone").split()
@@ -384,12 +418,15 @@ def synthetic_prompt_pool(n: int, max_new: tuple[int, int] = (2, 32),
                          f"got {max_new}")
     out = []
     for i in range(n):
-        prompt = " ".join(rng.choice(words, size=int(rng.integers(2, 8))))
+        is_long = long_every > 0 and i % long_every == long_every - 1
+        size = long_words if is_long else int(rng.integers(2, 8))
+        prompt = " ".join(rng.choice(words, size=size))
         body: dict = {"prompt": prompt, "seed": i}
         if not sd:
             # Deterministic spread over [lo, hi]: short and long lengths
             # interleave however the pool is cycled.
-            body["max_new_tokens"] = int(lo + (i * 7919) % (hi - lo + 1))
+            body["max_new_tokens"] = hi if is_long else int(
+                lo + (i * 7919) % (hi - lo + 1))
         out.append(json.dumps(body).encode())
     return out
 
@@ -683,8 +720,10 @@ def run_loadgen_cli(args) -> int:
         # counters only move when output lengths mix).
         lo, hi = (int(x) for x in
                   str(getattr(args, "max_new", "2,32")).split(","))
-        payload = synthetic_prompt_pool(distinct, (lo, hi),
-                                        sd=synth == "sd-prompt")
+        payload = synthetic_prompt_pool(
+            distinct, (lo, hi), sd=synth == "sd-prompt",
+            long_every=int(getattr(args, "long_every", 0) or 0),
+            long_words=int(getattr(args, "long_words", 16) or 16))
     elif distinct > 1:
         # Miss-only workload: a pool of distinct synthetic bodies, cycled
         # round-robin (a pool larger than the server's cache capacity makes

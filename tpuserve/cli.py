@@ -107,10 +107,18 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--stream", action="store_true",
                          help="closed-loop STREAMING mode (?stream=true, "
                               "SSE): reports first-token p50/p99, "
-                              "inter-token-gap p99, and exact tokens/s "
-                              "from token event timestamps; use with "
-                              "--synthetic prompt against a generative "
-                              "model (--rate/--procs don't apply)")
+                              "inter-token-gap p50/p99/max + histogram, "
+                              "and exact tokens/s from token event "
+                              "timestamps; use with --synthetic prompt "
+                              "against a generative model (--rate/--procs "
+                              "don't apply)")
+    p_bench.add_argument("--long-every", type=int, default=0,
+                         help="skew the --synthetic prompt pool: every "
+                              "Nth body is a --long-words-word prompt at "
+                              "the top of --max-new (0 = uniform pool)")
+    p_bench.add_argument("--long-words", type=int, default=16,
+                         help="prompt length (words) of the injected "
+                              "long bodies for --long-every")
 
     p_imp = sub.add_parser("import-model", help="convert TF SavedModel -> orbax checkpoint")
     p_imp.add_argument("--saved-model", required=True)

@@ -288,6 +288,31 @@ class GenserveConfig:
     # well-formed error event (reason "drain" — never a silent
     # truncation); 0 = streams only get the shared drain_timeout_s.
     stream_drain_s: float = 5.0
+    # Paged KV cache (ISSUE 18, docs/PERFORMANCE.md "Paged KV & chunked
+    # prefill"; PagedAttention/vLLM): families that implement the paged
+    # contract (textgen) allocate KV as fixed-size pages behind a
+    # device-resident block table instead of one dense worst-case-ctx slab
+    # per slot. Pages are reserved at fold-in (prompt + decode budget) and
+    # returned on retire/evict/disconnect; exhaustion sheds 503 with a
+    # Retry-After (reason kv_pressure). Default off: dense path stays
+    # byte-compatible, and families without paged programs (sd15) keep the
+    # dense slab regardless.
+    kv_paging: bool = False
+    # Tokens per KV page. Smaller pages track real context tighter (less
+    # internal fragmentation); larger pages mean fewer gather indices.
+    kv_page_tokens: int = 16
+    # Total device pages in the pool, INCLUDING the write-sink sentinel
+    # (page 0, never allocated). 0 = auto: slots * pages-per-max-ctx + 1,
+    # i.e. the same worst-case KV bytes as the dense slab — set it lower
+    # to hold memory fixed while raising [genserve] slots, which is the
+    # whole point of paging.
+    kv_pages: int = 0
+    # Chunked prefill (Orca-style iteration-level scheduling applied to
+    # the prompt): a paged prompt folds in this many tokens per engine
+    # iteration, interleaved with decode steps, so a max-length prompt
+    # never stalls in-flight decoders. 0 = whole prompt in one chunk
+    # (exactly the dense prefill math). Only meaningful with kv_paging.
+    prefill_chunk: int = 0
 
     def __post_init__(self) -> None:
         if self.slots < 0 or self.admit_per_step < 0:
@@ -299,6 +324,17 @@ class GenserveConfig:
         if self.stream_heartbeat_s < 0 or self.stream_drain_s < 0:
             raise ValueError(
                 "genserve.stream_heartbeat_s/stream_drain_s must be >= 0")
+        if self.kv_page_tokens < 1:
+            raise ValueError(
+                f"genserve.kv_page_tokens must be >= 1, got "
+                f"{self.kv_page_tokens}")
+        if self.kv_pages < 0 or self.prefill_chunk < 0:
+            raise ValueError(
+                "genserve.kv_pages/prefill_chunk must be >= 0")
+        if self.kv_pages == 1:
+            raise ValueError(
+                "genserve.kv_pages must be 0 (auto) or >= 2 (the pool "
+                "includes the sentinel page)")
 
 
 @dataclass

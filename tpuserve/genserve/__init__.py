@@ -10,11 +10,16 @@ generation engine").
   the active batch every model iteration, retires finished sequences
   immediately, folds queued requests into free slots, evicts past-deadline
   sequences with the fast-504 contract.
+- :class:`~tpuserve.genserve.pages.PageLedger` — host-side KV page ledger
+  for the paged cache (ISSUE 18; never double-hands a page), with
+  :class:`~tpuserve.genserve.engine.KVPressure` as the page-exhaustion
+  admission shed.
 """
 
 from tpuserve.genserve.arena import SlotArena, SlotCorrupted, SlotInfo
-from tpuserve.genserve.engine import GenEngine
+from tpuserve.genserve.engine import GenEngine, KVPressure
 from tpuserve.genserve.model import GenerativeModel
+from tpuserve.genserve.pages import PageCorrupted, PageLedger
 
-__all__ = ["GenEngine", "GenerativeModel", "SlotArena", "SlotCorrupted",
-           "SlotInfo"]
+__all__ = ["GenEngine", "GenerativeModel", "KVPressure", "PageCorrupted",
+           "PageLedger", "SlotArena", "SlotCorrupted", "SlotInfo"]

@@ -51,10 +51,25 @@ from tpuserve.batcher import DeadlineExceeded, QueueFull
 from tpuserve.config import GenserveConfig, PipelineConfig
 from tpuserve.genserve.arena import SlotArena, SlotInfo
 from tpuserve.genserve.model import GenerativeModel
+from tpuserve.genserve.pages import PageLedger
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.obs import PRIORITIES, Metrics
 
 log = logging.getLogger("tpuserve.genserve")
+
+
+class KVPressure(QueueFull):
+    """Paged-KV admission shed (ISSUE 18): the free-page ledger cannot
+    cover this request's prompt + decode reservation on top of demand
+    already queued. Subclasses QueueFull so every existing shed plumbing
+    (result-cache passthrough, submit re-raise) carries it unchanged; the
+    HTTP layer maps it to 503 with a clear-time Retry-After and shed
+    reason "kv_pressure" — the same contract queue-full sheds follow."""
+
+    def __init__(self, message: str,
+                 retry_after_s: float | None = None) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -63,6 +78,10 @@ class _GenRequest:
     future: asyncio.Future = field(repr=False)
     enqueued_at: float = 0.0
     deadline_at: float | None = None
+    # Paged mode: pages this request will reserve at fold-in (prompt +
+    # decode budget); 0 when paging is off. Summed over the queue it is
+    # the committed-demand term of the admission pressure check.
+    pages_needed: int = 0
     # Priority class resolved at admission (obs.PRIORITIES); None when the
     # fleet scheduler is off.
     priority: str | None = None
@@ -132,6 +151,32 @@ class GenEngine:
         self.injector = injector
         self.slots = self.gcfg.slots or max(self.cfg.batch_buckets)
         self.arena = SlotArena(self.slots)
+        # Paged KV cache (ISSUE 18): only families that ship the paged
+        # programs opt in — with kv_paging on, sd15 (no paged contract)
+        # keeps the dense slab byte-for-byte.
+        self.paging = bool(self.gcfg.kv_paging) \
+            and bool(getattr(model, "supports_kv_paging", False))
+        if self.gcfg.kv_paging and not self.paging:
+            log.info("%s: [genserve] kv_paging is on but the family has no "
+                     "paged programs — dense state slab kept",
+                     model.cfg.name)
+        self.pages: PageLedger | None = None
+        self._pps = 0            # block-table width (pages per max-ctx slot)
+        self._prefill_chunk = 0  # static chunk width of the prefill program
+        if self.paging:
+            pt = self.gcfg.kv_page_tokens
+            self._pps = int(model.kv_pages_per_slot(pt))
+            n_pages = self.gcfg.kv_pages or (self.slots * self._pps + 1)
+            if n_pages < self._pps + 1:
+                raise ValueError(
+                    f"{model.cfg.name}: [genserve] kv_pages={n_pages} cannot "
+                    f"cover one max-context request ({self._pps} pages + the "
+                    "sentinel)")
+            self.pages = PageLedger(n_pages, pt)
+            self._prefill_chunk = int(
+                model.kv_prefill_chunk(self.gcfg.prefill_chunk))
+        # High-water active-slot mark (bench's max_concurrent_slots).
+        self.peak_active = 0
         self._own_stages = stages is None
         self.stages = stages if stages is not None \
             else StageExecutors(pipeline_cfg or PipelineConfig(), metrics)
@@ -171,6 +216,17 @@ class GenEngine:
             f"gen_client_disconnects_total{{model={name}}}")
         self._c_stream_dropped = metrics.counter(
             f"gen_stream_dropped_total{{model={name}}}")
+        # Paged-KV observability (ISSUE 18), prebound like everything else
+        # so the telemetry sampler sees the rows from the first scrape.
+        self._g_kv_pages_total = metrics.gauge(
+            f"gen_kv_pages_total{{model={name}}}")
+        self._g_kv_pages_free = metrics.gauge(
+            f"gen_kv_pages_free{{model={name}}}")
+        self._g_kv_util = metrics.gauge(
+            f"gen_kv_page_utilization{{model={name}}}")
+        self._c_prefill_chunks = metrics.counter(
+            f"gen_prefill_chunks_total{{model={name}}}")
+        self._c_kv_shed = metrics.sched_shed_counter(name, "kv_pressure")
         self._default_priority = getattr(model.cfg, "priority", "interactive")
         self._h_qwait = {p: metrics.queue_wait_histogram(name, p)
                          for p in PRIORITIES}
@@ -191,6 +247,9 @@ class GenEngine:
         # Serving-rate model for estimate_clear_s (429 Retry-After).
         self._ewma_step_ms: float | None = None
         self._ewma_iters: float | None = None
+        # Pages-per-request EWMA (paged mode): the "typical admission" the
+        # kv_clear_s pressure signal prices.
+        self._ewma_pages: float | None = None
         # Runaway guard: a slot that somehow never reports done is failed
         # (and freed) past this bound instead of pinning its slot forever.
         self._max_steps_guard = 2 * max(1, model.gen_max_steps())
@@ -207,40 +266,88 @@ class GenEngine:
         ServerState.build."""
         model, rt = self.model, self.runtime
         t0 = time.perf_counter()
-        self._state_struct = model.state_signature(self.slots)
+        if self.paging:
+            # Paged state block: global page pool + per-slot block table.
+            # Page indices are TRACED (like slot indices), so this one
+            # registration serves every page assignment the ledger ever
+            # makes — the zero-recompile obligation extends to page churn.
+            self._state_struct = model.kv_page_signature(
+                self.slots, self.pages.pages, self.pages.page_tokens)
+        else:
+            self._state_struct = model.state_signature(self.slots)
+        geometry = {"kv_paging": self.paging, "slots": self.slots,
+                    "pages": self.pages.pages if self.paging else 0,
+                    "page_tokens": self.pages.page_tokens
+                    if self.paging else 0,
+                    "prefill_chunk": self._prefill_chunk}
         if "step" in rt.gen_programs:
             # Programs already registered on this runtime (a second engine
             # over the same runtime — tests, restarts). Reuse requires the
-            # same slot width: the compiled state block is shape-frozen.
+            # same slot width AND the same paging geometry: the compiled
+            # state block is shape-frozen.
             step_key = next(k for k in rt.variants
                             if k.bucket and k.bucket[0] == "step")
             if step_key.bucket[1] != self.slots:
                 raise ValueError(
                     f"{self.name}: runtime programs were compiled for "
                     f"{step_key.bucket[1]} slots, engine wants {self.slots}")
+            prior = getattr(rt, "gen_meta", None)
+            if prior and prior != geometry:
+                raise ValueError(
+                    f"{self.name}: runtime programs were compiled for "
+                    f"geometry {prior}, engine wants {geometry}")
             return
         item_struct = model.gen_item_signature()
         slot_struct = jax.ShapeDtypeStruct((), np.int32)
+        if self.paging:
+            start_struct = jax.ShapeDtypeStruct((), np.int32)
+            pages_struct = jax.ShapeDtypeStruct((self._pps,), np.int32)
+            chunk = self._prefill_chunk
 
-        def insert_fn(params, state, slot, item):
-            fresh = model.init_state(params, item)
-            return jax.tree_util.tree_map(
-                lambda s, u: jax.lax.dynamic_update_index_in_dim(
-                    s, u.astype(s.dtype), slot, 0),
-                state, fresh)
+            def prefill_fn(params, state, slot, item, start, pages):
+                return model.prefill_chunk(params, state, slot, item,
+                                           start, pages, chunk=chunk)
 
-        rt.register_program("insert", insert_fn,
-                            (self._state_struct, slot_struct, item_struct),
-                            width=self.slots, donate_argnums=(0,))
+            rt.register_program("prefill", prefill_fn,
+                                (self._state_struct, slot_struct,
+                                 item_struct, start_struct, pages_struct),
+                                width=self.slots, donate_argnums=(0,))
+        else:
+            def insert_fn(params, state, slot, item):
+                fresh = model.init_state(params, item)
+                return jax.tree_util.tree_map(
+                    lambda s, u: jax.lax.dynamic_update_index_in_dim(
+                        s, u.astype(s.dtype), slot, 0),
+                    state, fresh)
+
+            rt.register_program("insert", insert_fn,
+                                (self._state_struct, slot_struct,
+                                 item_struct),
+                                width=self.slots, donate_argnums=(0,))
         rt.register_program("step", model.step, (self._state_struct,),
                             width=self.slots, donate_argnums=(0,))
         rt.register_program("extract", model.extract,
                             (self._state_struct, slot_struct),
                             width=self.slots)
-        # Prewarm: one insert + step + extract on a zero state block, with a
-        # dependent read per program (the only honest completion signal).
-        state = rt.run_program("insert", self._host_zeros(self._state_struct),
-                               np.int32(0), model.canary_item())
+        rt.gen_meta = geometry
+        # Prewarm: one full fold-in + step + extract on a zero state block,
+        # with a dependent read per program (the only honest completion
+        # signal). Paged mode walks every prefill chunk of the canary so
+        # the chunked program loads too.
+        state = self._host_zeros(self._state_struct)
+        item = model.canary_item()
+        if self.paging:
+            row = np.arange(1, self._pps + 1, dtype=np.int32)
+            n_prompt = model.prompt_tokens(item)
+            start = 0
+            while True:
+                state = rt.run_program("prefill", state, np.int32(0), item,
+                                       np.int32(start), row)
+                start += self._prefill_chunk
+                if start >= n_prompt:
+                    break
+        else:
+            state = rt.run_program("insert", state, np.int32(0), item)
         state, out = rt.run_program("step", state)
         jax.tree_util.tree_map(np.asarray, out)
         jax.tree_util.tree_map(
@@ -256,6 +363,9 @@ class GenEngine:
     # -- lifecycle ------------------------------------------------------------
     async def start(self) -> None:
         self._state = self._host_zeros(self._state_struct)
+        if self.pages is not None:
+            self._g_kv_pages_total.set(float(self.pages.usable))
+            self._update_kv_gauges()
         self._work_event = asyncio.Event()
         self._idle_event = asyncio.Event()
         self._idle_event.set()
@@ -286,6 +396,9 @@ class GenEngine:
             self._terminate_stream(info.stream, "shutdown", str(err))
             if not info.future.done():
                 info.future.set_exception(err)
+        if self.pages is not None:
+            self.pages.release_all()
+            self._update_kv_gauges()
         self._g_queue_depth.set(0)
         self._g_active.set(0)
         self._maybe_idle()
@@ -372,11 +485,32 @@ class GenEngine:
         if len(self._pending) >= self.cfg.max_queue:
             self._c_shed.inc()
             raise QueueFull(self.name)
+        need = 0
+        if self.pages is not None:
+            # Page-pressure admission (ISSUE 18; budgeted admission,
+            # Clockwork P3).  An admitted request never hits mid-decode
+            # page exhaustion (its FULL reservation — prompt + decode
+            # budget — is taken at fold-in), so queued demand only costs
+            # latency, not correctness.  We therefore allow one pool
+            # turnover of backlog (pages recycle as sequences retire,
+            # exactly like the dense queue draining) and shed with a
+            # clear-time hint once projected demand exceeds that: at
+            # that point the page pool, not compute, is the bottleneck.
+            need = self.model.pages_needed(item, self.pages.page_tokens)
+            projected = self.pages.n_reserved + self._queued_pages() + need
+            if projected > 2 * self.pages.usable:
+                self._c_shed.inc()
+                self._c_kv_shed.inc()
+                raise KVPressure(
+                    f"{self.name}: kv page pool exhausted (need {need} "
+                    f"pages, {self.pages.n_free} free, "
+                    f"{self._queued_pages()} queued demand)",
+                    retry_after_s=self.kv_clear_s())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append(_GenRequest(
             item=item, future=fut, enqueued_at=time.perf_counter(),
             deadline_at=deadline_at, priority=priority, ctx=ctx,
-            stream=stream))
+            stream=stream, pages_needed=need))
         self._g_queue_depth.set(len(self._pending))
         self._idle_event.clear()
         self._work_event.set()
@@ -494,6 +628,37 @@ class GenEngine:
                 and not self.arena.n_active:
             self._idle_event.set()
 
+    # -- page ledger plumbing (event loop; ISSUE 18) --------------------------
+    def _release_slot(self, slot: int) -> SlotInfo:
+        """EVERY slot-release path funnels through here so the slot's KV
+        pages return to the free list the same instant the slot frees —
+        retire, evict, disconnect, runaway guard, insert failure alike."""
+        if self.pages is not None:
+            self.pages.release(slot)
+            self._update_kv_gauges()
+        return self.arena.release(slot)
+
+    def _update_kv_gauges(self) -> None:
+        self._g_kv_pages_free.set(float(self.pages.n_free))
+        self._g_kv_util.set(self.pages.utilization())
+
+    def _queued_pages(self) -> int:
+        """Pages the already-accepted queue will reserve once admitted
+        (the committed-demand term of the admission pressure check)."""
+        return sum(r.pages_needed for r in self._pending)
+
+    def _pages_row(self, page_list: "list[int]") -> np.ndarray:
+        """One slot's block-table row: its pages in position order, padded
+        with the sentinel (page 0) past its reservation."""
+        row = np.zeros((self._pps,), np.int32)
+        row[:len(page_list)] = page_list
+        return row
+
+    def _observe_pages(self, need: int) -> None:
+        prev = self._ewma_pages
+        self._ewma_pages = (float(need) if prev is None
+                            else prev + 0.2 * (need - prev))
+
     # -- step loop (event loop) -----------------------------------------------
     async def _step_loop(self) -> None:
         name = self.name
@@ -517,6 +682,7 @@ class GenEngine:
                     await self._work_event.wait()
                 continue
             await self._admit()
+            await self._advance_prefills()
             if not self.arena.n_active:
                 continue
             try:
@@ -566,6 +732,51 @@ class GenEngine:
     def _insert_sync(self, slot: int, item: Any) -> None:
         self._state = self.runtime.run_program(
             "insert", self._state, np.int32(slot), item)
+
+    def _prefill_sync(self, slot: int, item: Any, start: int,
+                      pages_row: np.ndarray) -> None:
+        self._state = self.runtime.run_program(
+            "prefill", self._state, np.int32(slot), item, np.int32(start),
+            pages_row)
+
+    async def _prefill_advance(self, slot: int, info: SlotInfo) -> None:
+        """Fold ONE more prompt chunk for a prefilling slot (runs on the
+        h2d stage like a dense insert). The compiled program arms the lane
+        for decode on the final chunk; the host cursor here is what tells
+        retire/step scheduling the slot is still mid-prefill."""
+        start = info.meta["prefill_next"]
+        await self.stages.run(self.name, "h2d", self._prefill_sync, slot,
+                              info.item, start, info.meta["pages_row"])
+        self._c_prefill_chunks.inc()
+        nxt = start + self._prefill_chunk
+        if nxt >= info.meta["prefill_n"]:
+            del info.meta["prefill_next"]  # prefill complete: decode owns it
+        else:
+            info.meta["prefill_next"] = nxt
+
+    async def _advance_prefills(self) -> None:
+        """One chunk per prefilling slot per engine iteration, interleaved
+        with decode steps (Orca's iteration-level scheduling applied to
+        prefill) — in-flight decoders see a bounded per-iteration stall
+        instead of a whole-prompt one."""
+        if self.pages is None:
+            return
+        for slot in self.arena.active_slots():
+            info = self.arena.peek(slot)
+            if "prefill_next" not in info.meta or info.future.done():
+                continue
+            try:
+                await self._prefill_advance(slot, info)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — same blast radius as
+                # an insert failure: the block may be half-written.
+                self._release_slot(slot)
+                self._terminate_stream(info.stream, "engine_error", str(e))
+                if not info.future.done():
+                    info.future.set_exception(e)
+                await self._fail_active(e)
+                return
 
     def _extract_sync(self, slot: int) -> Any:
         return jax.tree_util.tree_map(
@@ -626,7 +837,7 @@ class GenEngine:
                     self._c_disconnects.inc()
                     self._count_termination("disconnect")
                     info.stream.close()
-                self.arena.release(slot)
+                self._release_slot(slot)
                 continue
             if info.deadline_at is not None and now >= info.deadline_at:
                 msg = (f"deadline expired after {info.iterations} "
@@ -644,7 +855,7 @@ class GenEngine:
                     wall = time.time()
                     info.ctx.span("evict", wall, wall, tid=self.name,
                                   slot=slot, iterations=info.iterations)
-                self.arena.release(slot)
+                self._release_slot(slot)
                 continue
             if info.stream is not None and kill_at is not None \
                     and now >= kill_at:
@@ -659,7 +870,7 @@ class GenEngine:
                     info.ctx.span("evict", wall, wall, tid=self.name,
                                   slot=slot, iterations=info.iterations,
                                   reason="drain")
-                self.arena.release(slot)
+                self._release_slot(slot)
         self._g_active.set(self.arena.n_active)
 
     async def _admit(self) -> None:
@@ -680,6 +891,14 @@ class GenEngine:
                 req.future.set_exception(DeadlineExceeded(msg))
                 self._c_deadline.inc()
                 continue
+            if self.pages is not None \
+                    and self.pages.n_free < req.pages_needed:
+                # Head-of-line waits for pages to free (strict FIFO —
+                # skipping ahead would starve long-context requests); the
+                # admission-time pressure check bounds how long.
+                self._pending.appendleft(req)
+                self._g_queue_depth.set(len(self._pending))
+                break
             fold = any(self.arena.peek(s).iterations > 0
                        for s in self.arena.active_slots())
             info = SlotInfo(item=req.item, future=req.future,
@@ -687,6 +906,22 @@ class GenEngine:
                             enqueued_at=req.enqueued_at, admitted_at=now,
                             ctx=req.ctx, stream=req.stream)
             slot = self.arena.acquire(info)
+            if self.pages is not None:
+                try:
+                    page_list = self.pages.acquire(slot, req.pages_needed)
+                except Exception:
+                    self.arena.release(slot)
+                    raise
+                self._update_kv_gauges()
+                self._observe_pages(req.pages_needed)
+                n_prompt = self.model.prompt_tokens(req.item)
+                info.meta["pages_row"] = self._pages_row(page_list)
+                info.meta["prefill_n"] = n_prompt
+                info.meta["prefill_next"] = 0
+                info.meta["prefill_chunks"] = \
+                    -(-n_prompt // self._prefill_chunk)
+            if self.arena.n_active > self.peak_active:
+                self.peak_active = self.arena.n_active
             wait_ms = (now - req.enqueued_at) * 1e3
             trace_id = req.ctx.trace_id if req.ctx is not None else None
             self._h_queue.observe(wait_ms, trace_id=trace_id)
@@ -698,15 +933,22 @@ class GenEngine:
                              tid=self.name)
             t0 = time.perf_counter()
             try:
-                await self.stages.run(self.name, "h2d", self._insert_sync,
-                                      slot, req.item)
+                if self.pages is not None:
+                    # Paged fold-in is incremental: the FIRST prompt chunk
+                    # lands now, later chunks interleave with decode steps
+                    # (_advance_prefills) so a long prompt never stalls
+                    # the block for one monolithic prefill.
+                    await self._prefill_advance(slot, info)
+                else:
+                    await self.stages.run(self.name, "h2d",
+                                          self._insert_sync, slot, req.item)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001
                 # The state block may be half-written (and donated buffers
                 # consumed on TPU): hard-reset like a step failure. The
                 # admitting request fails with the cause too.
-                self.arena.release(slot)
+                self._release_slot(slot)
                 self._terminate_stream(req.stream, "engine_error", str(e))
                 if not req.future.done():
                     req.future.set_exception(e)
@@ -741,16 +983,23 @@ class GenEngine:
                     self._c_disconnects.inc()
                     self._count_termination("disconnect")
                     info.stream.close()
-                self.arena.release(slot)
+                self._release_slot(slot)
                 continue
-            if info.iterations > self._max_steps_guard:
+            # Prefill chunks ride the same iteration counter, so a paged
+            # slot's guard stretches by its chunk count.
+            guard = self._max_steps_guard + info.meta.get("prefill_chunks", 0)
+            if info.iterations > guard:
                 msg = (f"{self.name}: slot {slot} exceeded the "
-                       f"{self._max_steps_guard}-iteration guard without "
+                       f"{guard}-iteration guard without "
                        "reporting done")
                 self._terminate_stream(info.stream, "engine_error", msg)
                 info.future.set_exception(RuntimeError(msg))
                 self._c_batch_errors.inc()
-                self.arena.release(slot)
+                self._release_slot(slot)
+                continue
+            if "prefill_next" in info.meta:
+                # Mid-prefill: the lane's device done-flag is its FREEZE
+                # (interleaved decode steps skip it), not completion.
                 continue
             if not self.model.is_finished(out, slot):
                 continue
@@ -809,7 +1058,7 @@ class GenEngine:
                     wall1 - (time.perf_counter() - info.enqueued_at), wall1,
                     tid=self.name, trace_id=trace_id, slot=slot,
                     iterations=info.iterations)
-            self.arena.release(slot)
+            self._release_slot(slot)
         self._g_active.set(self.arena.n_active)
         self._maybe_idle()
 
@@ -831,6 +1080,9 @@ class GenEngine:
                 info.ctx.span("engine_failure", wall, wall, tid=self.name,
                               iterations=info.iterations,
                               error=type(e).__name__)
+        if self.pages is not None:
+            self.pages.release_all()
+            self._update_kv_gauges()
         self._state = self._host_zeros(self._state_struct)
         self._g_active.set(0)
         self._maybe_idle()
@@ -846,9 +1098,21 @@ class GenEngine:
         staged-canary path for engine-served models)."""
         model, rt = self.model, self.runtime
         item = model.canary_item()
-        state = rt.run_program(
-            "insert", self._host_zeros(self._state_struct), np.int32(0),
-            item, params_override=staged)
+        state = self._host_zeros(self._state_struct)
+        if self.paging:
+            row = np.arange(1, self._pps + 1, dtype=np.int32)
+            n_prompt = model.prompt_tokens(item)
+            start = 0
+            while True:
+                state = rt.run_program("prefill", state, np.int32(0), item,
+                                       np.int32(start), row,
+                                       params_override=staged)
+                start += self._prefill_chunk
+                if start >= n_prompt:
+                    break
+        else:
+            state = rt.run_program("insert", state, np.int32(0), item,
+                                   params_override=staged)
         for _ in range(self._max_steps_guard):
             state, out = rt.run_program("step", state, params_override=staged)
             if bool(np.asarray(out["done"])[0]):
@@ -895,18 +1159,40 @@ class GenEngine:
             return None
         return max(1, n_items) * self._ewma_iters * self._ewma_step_ms / 1e3
 
+    def kv_clear_s(self) -> float | None:
+        """Page-pressure term (paged mode only): estimated seconds until
+        enough pages free for a typical admission — the Retry-After hint
+        on a kv_pressure shed and a term FleetScheduler.predict_completion_s
+        adds so deadline_unmeetable fires before enqueue. None when paging
+        is off or the ledger already covers a typical request with nothing
+        queued ahead. The soonest page return is the most-advanced active
+        request finishing: one request's EWMA span over the active count
+        (uniform-progress assumption, same modeling posture as
+        estimate_clear_s)."""
+        if self.pages is None:
+            return None
+        need = self._ewma_pages or 1.0
+        if self.pages.n_free >= need and not self._pending:
+            return None
+        if not self._ewma_step_ms or not self._ewma_iters:
+            return None
+        per_req_s = self._ewma_iters * self._ewma_step_ms / 1e3
+        return per_req_s / max(1, self.arena.n_active)
+
     def estimate_clear_s(self) -> float | None:
         """Queue-clear estimate (raw, unclamped — same split as the
         batcher's: ``clamp_retry_after_s`` owns the 429 Retry-After hint):
         pending requests
         times the observed iterations-per-request, priced at the step EWMA,
-        amortized over the slot width. None before any retirement."""
+        amortized over the slot width, plus the page-pressure term when
+        paging is on. None before any retirement."""
         if not self._pending:
             return None
         if not self._ewma_step_ms or not self._ewma_iters:
             return None
         per_req_s = self._ewma_iters * self._ewma_step_ms / 1e3
-        return len(self._pending) * per_req_s / max(1, self.slots)
+        base = len(self._pending) * per_req_s / max(1, self.slots)
+        return base + (self.kv_clear_s() or 0.0)
 
     def pipeline_stats(self) -> dict:
         """The /stats "pipeline" block entry for this model (the engine's
@@ -914,11 +1200,12 @@ class GenEngine:
         per_slot = [
             {"slot": s, "iterations": self.arena.peek(s).iterations}
             for s in self.arena.active_slots()]
-        return {
+        stats = {
             "mode": "genserve",
             "slots": self.slots,
             "active": self.arena.n_active,
             "free": self.arena.n_free,
+            "peak_active": self.peak_active,
             "pending": len(self._pending),
             "admitted_total": self.arena.acquires_total,
             "iterations_total": self._c_iterations.value,
@@ -931,3 +1218,25 @@ class GenEngine:
             if self._ewma_iters else None,
             "per_slot": per_slot,
         }
+        if self.pages is not None:
+            stats["kv"] = {
+                **self.pages.stats(),
+                "prefill_chunk": self._prefill_chunk,
+                "prefill_chunks_total": self._c_prefill_chunks.value,
+                "queued_pages": self._queued_pages(),
+                "kv_bytes": self.kv_cache_bytes(),
+            }
+        return stats
+
+    def kv_cache_bytes(self) -> int:
+        """Device bytes the KV storage leaves occupy (dense slab k/v or the
+        paged pool kp/vp) — the denominator of the bench's fixed-memory
+        slot-count comparison."""
+        total = 0
+        if isinstance(self._state_struct, dict):
+            for key in ("k", "v", "kp", "vp"):
+                leaf = self._state_struct.get(key)
+                if leaf is not None:
+                    total += (int(np.prod(leaf.shape))
+                              * np.dtype(leaf.dtype).itemsize)
+        return total

@@ -109,6 +109,61 @@ class GenerativeModel(ServingModel):
         headline (counting requests would hide mixed output lengths)."""
         return 1.0
 
+    # -- paged KV contract (ISSUE 18) -----------------------------------------
+    # Families that answer supports_kv_paging = True swap the dense
+    # per-slot state slab for a global pool of fixed-size KV pages plus a
+    # per-slot block table, and swap init_state for an incremental
+    # prefill_chunk program. The engine keeps the page ledger
+    # (tpuserve.genserve.pages.PageLedger) host-side; EVERY page index the
+    # compiled programs consume is traced, so one compiled step/prefill
+    # serves every page assignment — the same zero-recompile obligation
+    # slot indices already carry (runtime.register_program).
+
+    # Opt-in marker; families without paged programs (sd15) keep the
+    # dense slab even when [genserve] kv_paging is on.
+    supports_kv_paging = False
+
+    def kv_page_signature(self, slots: int, pages: int,
+                          page_tokens: int) -> Any:
+        """Pytree of jax.ShapeDtypeStruct for the PAGED state block: the
+        global page pool (leading dim ``pages``), the per-slot block table
+        of page indices, and the same per-slot scalar lanes the dense
+        signature carries. Page 0 is the write-sink sentinel — free/done
+        lanes scribble there, live lanes never attend through it."""
+        raise NotImplementedError
+
+    def kv_pages_per_slot(self, page_tokens: int) -> int:
+        """Host-side: block-table width — pages covering one slot's
+        worst-case context (ceil(max_ctx / page_tokens))."""
+        raise NotImplementedError
+
+    def pages_needed(self, item: Any, page_tokens: int) -> int:
+        """Host-side: pages this request reserves at fold-in — its prompt
+        PLUS its full decode budget, so an admitted sequence can never hit
+        mid-decode page exhaustion (budgeted admission, Clockwork P3)."""
+        raise NotImplementedError
+
+    def prompt_tokens(self, item: Any) -> int:
+        """Host-side: real (unpadded) prompt length of one decoded item —
+        the engine's chunked-prefill cursor bound."""
+        raise NotImplementedError
+
+    def kv_prefill_chunk(self, requested: int) -> int:
+        """Host-side: the static chunk width the compiled prefill program
+        is built with, given the [genserve] prefill_chunk knob (0 = whole
+        prompt in one chunk)."""
+        raise NotImplementedError
+
+    def prefill_chunk(self, params: Any, state: Any, slot: Any, item: Any,
+                      start: Any, pages: Any, *, chunk: int) -> Any:
+        """Jittable with TRACED slot/start/page indices, STATIC chunk
+        width: fold tokens [start, start+chunk) of one prompt into the
+        slot's pages and return the new state. The final chunk (start +
+        chunk >= prompt length) also samples the first token and arms the
+        lane for decode; earlier chunks leave the lane frozen
+        (done=True) so interleaved decode steps skip it."""
+        raise NotImplementedError
+
     # -- streaming contract (ISSUE 17) ----------------------------------------
     # The engine calls stream_units after EVERY fetched iteration for each
     # slot with an attached stream, and stream_final_units once at retire;

@@ -315,6 +315,11 @@ class TextGenServing(GenerativeModel):
         return jax.tree_util.tree_map(lambda x: x[0], state)
 
     def step(self, params: Any, state: Any) -> tuple[Any, dict]:
+        # The state pytree's own shape selects the path (a host-side
+        # structural check at trace time): a paged engine allocates the
+        # kv_page_signature block, a dense one the state_signature block.
+        if "kp" in state:
+            return self._paged_decode_step(params, state)
         return self._decode_step(params, state)
 
     def extract(self, params: Any, state: Any, slot: Any) -> Any:
@@ -326,6 +331,239 @@ class TextGenServing(GenerativeModel):
 
     def gen_max_steps(self) -> int:
         return self.max_new
+
+    # -- paged KV path (ISSUE 18; PagedAttention/vLLM) ------------------------
+    # KV lives in one global pool of fixed-size pages --
+    # (pages, layers, page_tokens, heads, head_dim) -- addressed through a
+    # per-slot block table of TRACED page indices, so the one compiled
+    # step serves every page assignment (the zero-recompile obligation
+    # slot indices already carry). Global position p of a slot lives at
+    # (bt[slot, p // page_tokens], p % page_tokens). Page 0 is the
+    # write-sink sentinel: free and frozen lanes scribble there instead
+    # of into pages the ledger may have re-handed to another request.
+
+    supports_kv_paging = True
+
+    def kv_pages_per_slot(self, page_tokens: int) -> int:
+        return -(-self.max_ctx // int(page_tokens))
+
+    def kv_page_signature(self, slots: int, pages: int,
+                          page_tokens: int) -> Any:
+        ln, h, hd = self.layers, self.heads, self.head_dim
+        pps = self.kv_pages_per_slot(page_tokens)
+        return {
+            "kp": jax.ShapeDtypeStruct(
+                (pages, ln, page_tokens, h, hd), self.dtype),
+            "vp": jax.ShapeDtypeStruct(
+                (pages, ln, page_tokens, h, hd), self.dtype),
+            "bt": jax.ShapeDtypeStruct((slots, pps), jnp.int32),
+            "pos": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "tokens": jax.ShapeDtypeStruct((slots, self.max_new), jnp.int32),
+            "n_new": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "last": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "done": jax.ShapeDtypeStruct((slots,), jnp.bool_),
+            "seed": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "max_new": jax.ShapeDtypeStruct((slots,), jnp.int32),
+            "temp": jax.ShapeDtypeStruct((slots,), jnp.float32),
+        }
+
+    def pages_needed(self, item: Any, page_tokens: int) -> int:
+        _ids, n, _seed, max_new, _temp = item
+        return -(-(int(n) + int(max_new)) // int(page_tokens))
+
+    def prompt_tokens(self, item: Any) -> int:
+        return int(item[1])
+
+    def kv_prefill_chunk(self, requested: int) -> int:
+        if requested <= 0 or requested >= self.max_prompt:
+            return self.max_prompt
+        return int(requested)
+
+    def _lane_update(self, state, slot, name, value):
+        arr = state[name]
+        return jax.lax.dynamic_update_index_in_dim(
+            arr, jnp.asarray(value).astype(arr.dtype), slot, 0)
+
+    def prefill_chunk(self, params: Any, state: Any, slot: Any, item: Any,
+                      start: Any, pages: Any, *, chunk: int) -> Any:
+        # Whole-prompt chunk (the prefill_chunk = 0 default) routes through
+        # init_state VERBATIM and only changes where K/V is stored, so
+        # paged == dense token parity holds by construction.
+        if chunk >= self.max_prompt:
+            return self._prefill_paged_single(params, state, slot, item,
+                                              pages)
+        return self._prefill_paged_chunk(params, state, slot, item, start,
+                                         pages, chunk)
+
+    def _scatter_pages(self, state, pages, n, positions, per_layer_kv):
+        """Write per-position K/V rows into the page pool: position p goes
+        to (pages[p // P], p % P); positions >= n (padding) divert to the
+        sentinel. ``per_layer_kv(i) -> (k, v)`` each (len(positions), h, hd)."""
+        kp, vp = state["kp"], state["vp"]
+        P = kp.shape[2]
+        pps = state["bt"].shape[1]
+        w_pages = jnp.where(
+            positions < n,
+            jnp.take(pages, jnp.minimum(positions // P, pps - 1), axis=0),
+            0)
+        offs = positions % P
+        for i in range(self.layers):
+            k, v = per_layer_kv(i)
+            kp = kp.at[w_pages, i, offs].set(k)
+            vp = vp.at[w_pages, i, offs].set(v)
+        return kp, vp
+
+    def _prefill_paged_single(self, params, state, slot, item, pages):
+        _ids, n, _seed, _max_new, _temp = item
+        lane = self.init_state(params, item)  # dense prefill, b=1
+        p = self.max_prompt
+        kp, vp = self._scatter_pages(
+            state, pages, n, jnp.arange(p),
+            lambda i: (lane["k"][i, :p], lane["v"][i, :p]))
+        new = {"kp": kp, "vp": vp,
+               "bt": jax.lax.dynamic_update_index_in_dim(
+                   state["bt"], pages, slot, 0)}
+        for f in ("pos", "tokens", "n_new", "last", "done", "seed",
+                  "max_new", "temp"):
+            new[f] = self._lane_update(state, slot, f, lane[f])
+        return new
+
+    def _prefill_paged_chunk(self, params, state, slot, item, start, pages,
+                             chunk):
+        """One chunk of an incremental prompt prefill: BIDIRECTIONAL within
+        the chunk, causal across chunks (earlier chunks' K/V are final by
+        the time later chunks attend through them). Multi-chunk encoding is
+        therefore NOT bit-identical to the one-pass bidirectional prefill —
+        it is a deterministic function of (prompt, seed, chunk width)
+        alone, independent of batch composition and of what else the
+        engine interleaves (the invariant tests gate on). Non-final chunks
+        leave the lane frozen (done=True, pos=0) so interleaved decode
+        steps skip it; the final chunk samples the first token and arms
+        the lane exactly like init_state does."""
+        ids, n, seed, max_new, temp = item
+        C = int(chunk)
+        ln, h, hd = self.layers, self.heads, self.head_dim
+        dt = self.dtype
+        kp, vp = state["kp"], state["vp"]
+        P = kp.shape[2]
+        pps = state["bt"].shape[1]
+        c_pad = pps * P
+        bt = jax.lax.dynamic_update_index_in_dim(state["bt"], pages, slot, 0)
+        cpos = start + jnp.arange(C)
+        cids = jnp.take(ids, jnp.minimum(cpos, self.max_prompt - 1), axis=0)
+        x = (jnp.take(params["embed"], cids, axis=0)
+             + jnp.take(params["pos"],
+                        jnp.minimum(cpos, self.max_ctx - 1), axis=0)
+             ).astype(dt)
+        kv_limit = jnp.minimum(start + C, n)
+        w_pages = jnp.where(
+            cpos < n,
+            jnp.take(pages, jnp.minimum(cpos // P, pps - 1), axis=0), 0)
+        offs = cpos % P
+        mask = (jnp.arange(c_pad)[None, :] >= kv_limit) * jnp.float32(-1e9)
+        for i in range(ln):
+            lp = params[f"layer{i}"]
+            hx = _norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+            q = (hx @ lp["wq"].astype(dt)).reshape(C, h, hd)
+            k = (hx @ lp["wk"].astype(dt)).reshape(C, h, hd)
+            v = (hx @ lp["wv"].astype(dt)).reshape(C, h, hd)
+            kp = kp.at[w_pages, i, offs].set(k)
+            vp = vp.at[w_pages, i, offs].set(v)
+            # Gather THIS slot's context (earlier chunks + the rows just
+            # written) back out of the pool; sentinel rows sit past
+            # kv_limit and are masked.
+            kall = jnp.take(kp[:, i], pages, axis=0).reshape(c_pad, h, hd)
+            vall = jnp.take(vp[:, i], pages, axis=0).reshape(c_pad, h, hd)
+            s = (jnp.einsum("qhd,khd->hqk", q, kall).astype(jnp.float32)
+                 * (hd ** -0.5)) + mask
+            a = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("hqk,khd->qhd", a, vall).reshape(C, h * hd)
+            x = x + o @ lp["wo"].astype(dt)
+            hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
+            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
+                     @ lp["w_down"].astype(dt))
+        last_off = jnp.clip(n - 1 - start, 0, C - 1)
+        h_last = jax.lax.dynamic_index_in_dim(x, last_off, 0, keepdims=False)
+        logits = self._logits(params, h_last[None, None, :])[0, 0]
+        first = self._sample(logits[None], seed[None], n[None], temp[None])[0]
+        is_final = (start + C) >= n
+        first_tok = jnp.where(is_final, first, jnp.int32(0))
+        new = {"kp": kp, "vp": vp, "bt": bt}
+        lane = {
+            "pos": jnp.where(is_final, n, jnp.int32(0)),
+            "tokens": jnp.zeros((self.max_new,), jnp.int32)
+                         .at[0].set(first_tok),
+            "n_new": jnp.where(is_final, jnp.int32(1), jnp.int32(0)),
+            "last": first_tok,
+            "done": jnp.where(is_final,
+                              (first == self.eos_id) | (max_new <= 1),
+                              jnp.bool_(True)),
+            "seed": seed, "max_new": max_new, "temp": temp,
+        }
+        for f, val in lane.items():
+            new[f] = self._lane_update(state, slot, f, val)
+        return new
+
+    def _paged_decode_step(self, params, state):
+        """The paged twin of _decode_step: identical math and sampling,
+        but K/V reads gather through the block table and writes go to
+        (page, offset) — frozen/free lanes' writes divert to the sentinel
+        so a released slot can never scribble into re-handed pages."""
+        kp, vp, bt = state["kp"], state["vp"], state["bt"]
+        b, pps = bt.shape
+        P = kp.shape[2]
+        ln, h, hd, c = self.layers, self.heads, self.head_dim, self.max_ctx
+        c_pad = pps * P
+        dt = self.dtype
+        pos = state["pos"]
+        done = state["done"]
+        rows = jnp.arange(b)
+        x = (jnp.take(params["embed"], state["last"], axis=0)
+             + jnp.take(params["pos"], jnp.clip(pos, 0, c - 1), axis=0)
+             ).astype(dt)
+        mask = (jnp.arange(c_pad)[None, :] > pos[:, None]) * jnp.float32(-1e9)
+        cp = jnp.clip(pos, 0, c - 1)
+        page_of = jnp.take_along_axis(bt, (cp // P)[:, None], axis=1)[:, 0]
+        w_page = jnp.where(done, 0, page_of)
+        offs = cp % P
+        for i in range(ln):
+            lp = params[f"layer{i}"]
+            hx = _norm(x, lp["ln1"]["scale"], lp["ln1"]["bias"])
+            q = (hx @ lp["wq"].astype(dt)).reshape(b, h, hd)
+            k = (hx @ lp["wk"].astype(dt)).reshape(b, h, hd)
+            v = (hx @ lp["wv"].astype(dt)).reshape(b, h, hd)
+            kp = kp.at[w_page, i, offs].set(k)
+            vp = vp.at[w_page, i, offs].set(v)
+            kc = jnp.take(kp[:, i], bt, axis=0).reshape(b, c_pad, h, hd)
+            vc = jnp.take(vp[:, i], bt, axis=0).reshape(b, c_pad, h, hd)
+            s = (jnp.einsum("bhd,bchd->bhc", q, kc)
+                 .astype(jnp.float32) * (hd ** -0.5)) + mask[:, None, :]
+            a = jax.nn.softmax(s, axis=-1).astype(dt)
+            o = jnp.einsum("bhc,bchd->bhd", a, vc).reshape(b, h * hd)
+            x = x + o @ lp["wo"].astype(dt)
+            hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
+            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
+                     @ lp["w_down"].astype(dt))
+        logits = self._logits(params, x[:, None, :])[:, 0, :]
+        sampled = self._sample(logits, state["seed"],
+                               jnp.clip(pos + 1, 0, c - 1), state["temp"])
+        n_new = state["n_new"]
+        write_idx = jnp.clip(n_new, 0, self.max_new - 1)
+        tokens = state["tokens"].at[rows, write_idx].set(
+            jnp.where(done, state["tokens"][rows, write_idx], sampled))
+        n_new2 = jnp.where(done, n_new, n_new + 1)
+        done2 = done | (sampled == self.eos_id) | (n_new2 >= state["max_new"])
+        new_state = {
+            "kp": kp, "vp": vp, "bt": bt,
+            "pos": jnp.where(done, pos, jnp.clip(pos + 1, 0, c - 1)),
+            "tokens": tokens,
+            "n_new": n_new2,
+            "last": jnp.where(done, state["last"], sampled),
+            "done": done2,
+            "seed": state["seed"], "max_new": state["max_new"],
+            "temp": state["temp"],
+        }
+        return new_state, {"done": done2, "n_new": n_new2, "tokens": tokens}
 
     # -- host side ------------------------------------------------------------
     def host_decode(self, payload: bytes, content_type: str) -> Any:

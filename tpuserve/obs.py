@@ -558,9 +558,13 @@ SLO_ALERT_STATES = {"ok": 0.0, "pending": 1.0, "firing": 2.0}
 # admission (fast 504, Clockwork P3); "priority_shed" — batch-class work
 # shed under fleet saturation; "share_exceeded" — an over-allowance model
 # shed while another model's interactive traffic was starved below
-# min_share; "model_warming" — shed during a cold model's warming window.
+# min_share; "model_warming" — shed during a cold model's warming window;
+# "kv_pressure" — the paged generation engine's free-page ledger cannot
+# cover the request's prompt + decode reservation (ISSUE 18; 503 with a
+# clear-time Retry-After, same contract as queue-full).
 SCHED_SHED_REASONS = ("deadline_unmeetable", "priority_shed",
-                      "share_exceeded", "model_warming", "burn_shed")
+                      "share_exceeded", "model_warming", "burn_shed",
+                      "kv_pressure")
 
 # Tenant admission rejections (tpuserve.scheduler.tenants), by cause.
 TENANT_SHED_REASONS = ("tenant_unknown", "tenant_rate_exceeded",

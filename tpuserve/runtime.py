@@ -272,6 +272,11 @@ class ModelRuntime:
         # Generative programs (tpuserve.genserve): tag -> GenProgram. Kept
         # off the forward hot-path view but inside the variant registry.
         self.gen_programs: dict[str, GenProgram] = {}
+        # The generation engine's compiled-geometry record (slot width,
+        # paged-KV pool shape, prefill chunk): a second engine reusing
+        # this runtime's programs must match it exactly — the state block
+        # is shape-frozen (genserve.engine.GenEngine.compile).
+        self.gen_meta: dict = {}
         # False when this runtime backs an iteration-level engine: the
         # engine's programs replace the forward bucket executables, so
         # compile_all/ensure_compiled must not build (or re-demand) them.
@@ -574,6 +579,13 @@ class ModelRuntime:
         Weight versions stay out of the key exactly as for forward buckets:
         publish/rollback swap trees under unchanged shapes, so every
         version reuses the registered program.
+
+        The zero-recompile obligation covers every index a program
+        consumes: slot indices AND — for the paged-KV programs (ISSUE 18)
+        — page/block-table indices and the chunk-start cursor are all
+        TRACED arguments, never baked into shapes, so slot churn, page
+        churn, and chunked-prefill progress all replay the same compiled
+        executables (``runtime_compiles_total`` steady-state delta 0).
 
         v1 composes with single-mesh layouts only ("single"/"sharded" —
         the engine owns one device state block); ``arg_structs`` leaves are

@@ -177,10 +177,19 @@ class FleetScheduler:
     def predict_completion_s(self, model: str, n_items: int = 1) -> float | None:
         """Predicted seconds until a request admitted NOW completes: the
         raw (unclamped) queue-clear estimate plus the service-time EWMA of
-        the bucket covering it. None before any duration evidence exists
-        (admit optimistically — shedding needs proof)."""
+        the bucket covering it, plus — for a paged generation engine
+        (ISSUE 18) — the page-pressure term (kv_clear_s), so an exhausted
+        page ledger makes deadline_unmeetable fire BEFORE enqueue even
+        when the queue itself is empty. None before any duration evidence
+        exists (admit optimistically — shedding needs proof)."""
         e = self._entries[model]
         clear = e.batcher.estimate_clear_s() or 0.0
+        kv_fn = getattr(e.batcher, "kv_clear_s", None)
+        kv = (kv_fn() or 0.0) if callable(kv_fn) else 0.0
+        # estimate_clear_s already folds kv pressure in when a queue
+        # exists; the standalone term matters when pending == 0.
+        if clear <= 0.0:
+            clear = kv
         svc = e.batcher.predicted_service_s(n_items)
         if svc is None and clear <= 0.0:
             return None

@@ -66,7 +66,7 @@ from tpuserve.bench.roofline import compute_split, phase_p50
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig, SloConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
-from tpuserve.genserve import GenEngine
+from tpuserve.genserve import GenEngine, KVPressure
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
 from tpuserve.obs import (PRIORITIES, FlightRecorder, Metrics, TraceContext,
@@ -773,6 +773,14 @@ class ServerState:
                                    if b is not None else None)
         return hint if hint is not None else self.shed_retry_after()
 
+    def kv_retry_after(self, name: str, exc: Exception) -> int:
+        """Retry-After seconds for paged-KV pressure 503s (ISSUE 18): the
+        engine's page-clear estimate carried on the KVPressure itself,
+        clamped like every shed hint; falls back to the queue-clear hint
+        before the engine has duration evidence."""
+        hint = clamp_retry_after_s(getattr(exc, "retry_after_s", None))
+        return hint if hint is not None else self.queue_retry_after(name)
+
     def breaker_retry_after(self, name: str) -> int:
         """Retry-After seconds for breaker 503s, derived from live state:
         the time until the NEXT periodic canary — the probe that half-opens
@@ -1195,6 +1203,12 @@ async def _predict_traced(request: web.Request, state: ServerState,
             state, lambda: _submit_and_gather(
                 state, name, model, items, deadline_at, priority,
                 timeout_ms, ctx, tenant))
+    except KVPressure as e:
+        # Paged-KV admission shed (ISSUE 18): the fast-shed contract of
+        # queue-full, but 503 with reason "kv_pressure" so clients (and
+        # the router) can tell memory pressure from queue pressure.
+        return _err(503, str(e), retry_after=state.kv_retry_after(name, e),
+                    reason="kv_pressure", trace=ctx)
     except QueueFull:
         return _err(429, "queue full, retry later",
                     retry_after=state.queue_retry_after(name), trace=ctx)
@@ -1300,6 +1314,10 @@ async def _predict_stream(request: web.Request, state: ServerState,
 
     try:
         fut, stream = await _on_main(state, _submit)
+    except KVPressure as e:
+        # Shed before any stream byte: plain 503 + reason, no SSE involved.
+        return _err(503, str(e), retry_after=state.kv_retry_after(name, e),
+                    reason="kv_pressure", trace=ctx)
     except QueueFull:
         return _err(429, "queue full, retry later",
                     retry_after=state.queue_retry_after(name), trace=ctx)
