@@ -20,6 +20,7 @@ export JAX_PLATFORMS=cpu
 # loop is deliberately lock-free (event-loop-only state), so the witness
 # proves no stage-executor path holds a lock across an await either.
 export TPUSERVE_LOCK_WITNESS=1
+export TPUSERVE_RETRACE_WITNESS=1
 
 python - <<'EOF'
 import asyncio
@@ -114,10 +115,18 @@ async def main() -> None:
         served = [v for k, v in m1.items()
                   if k.startswith("runtime_variant_batches_total") and v > 0]
         assert served, f"no gen program serving counters moved: {m1}"
+        # Retrace witness (TPUSERVE_RETRACE_WITNESS=1): armed, barrier
+        # declared, zero violations — a post-barrier compile or unblessed
+        # device->host fetch would have raised mid-load, not just here.
+        rw = stats["robustness"]["retrace_witness"]
+        assert rw["enabled"] and rw["barrier_declared"], rw
+        assert rw["violations"] == [], rw
         print(f"genserve smoke OK: {res2.throughput:.1f} req/s, "
               f"compiles delta 0 (total {m1[key]:.0f}), "
               f"early_exits {early:.0f}, fold_ins {folds:.0f}, "
-              f"iterations {iters:.0f}")
+              f"iterations {iters:.0f}, retrace witness clean "
+              f"(warmup {rw['warmup_compiles']}, "
+              f"sanctioned {rw['sanctioned_compiles']})")
     finally:
         await runner.cleanup()
 

@@ -21,6 +21,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.." || exit 1
 export JAX_PLATFORMS=cpu
 export TPUSERVE_LOCK_WITNESS=1
+export TPUSERVE_RETRACE_WITNESS=1
 
 python - <<'EOF'
 import asyncio
@@ -136,6 +137,12 @@ async def main() -> None:
         # Ledger exactly balanced after drain: every page came home.
         assert gs["active"] == 0 and gs["free"] == SLOTS, gs
         assert kv["reserved"] == 0 and kv["free"] == kv["usable"], kv
+
+        # Retrace witness: armed through the whole page-churn run with
+        # zero violations (a retrace would have raised mid-load).
+        rw = stats["robustness"]["retrace_witness"]
+        assert rw["enabled"] and rw["barrier_declared"], rw
+        assert rw["violations"] == [], rw
 
         print(f"pagedkv smoke OK: peak slots {peak} > dense-equiv "
               f"{dense_equiv} at {kv['usable'] * kv['page_tokens']} KV "

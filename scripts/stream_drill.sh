@@ -21,6 +21,7 @@ export JAX_PLATFORMS=cpu
 # Race-detection pass rides along (docs/ANALYSIS.md): router, supervisor,
 # engine stream channels, and both workers run under witnessed locks.
 export TPUSERVE_LOCK_WITNESS=1
+export TPUSERVE_RETRACE_WITNESS=1
 
 CFG="$(mktemp /tmp/tpuserve_stream_drill.XXXXXX.toml)"
 OUT="$(mktemp /tmp/tpuserve_stream_drill.XXXXXX.json)"

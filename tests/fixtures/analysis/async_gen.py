@@ -1,0 +1,37 @@
+"""TPS101 must descend into async generators: a blocking call inside an
+``async def`` generator body (or reached through its ``async for``
+consumer) stalls the event loop exactly like one in a plain coroutine.
+Positive cases are ``bad_*``; ``good_*`` must stay clean."""
+
+import asyncio
+import time
+
+
+class Streamer:
+    async def bad_gen(self):
+        for i in range(3):
+            time.sleep(0.1)  # blocks the loop mid-stream
+            yield i
+
+    async def bad_consumer(self):
+        out = []
+        async for item in self.bad_gen():  # reaches the blocking body
+            out.append(item)
+        return out
+
+    async def good_gen(self):
+        for i in range(3):
+            await asyncio.sleep(0.1)
+            yield i
+
+    async def good_consumer(self):
+        out = []
+        async for item in self.good_gen():
+            out.append(item)
+        return out
+
+    async def good_done_guarded(self, task):
+        # .result() under an explicit done() guard cannot block.
+        if task.done():
+            return task.result()
+        return await task

@@ -1,0 +1,79 @@
+"""TPS601 positive/negative cases: ledger acquire/release balance along
+the AST. Positive cases are ``bad_*``; ``good_*`` must stay clean — the
+protection patterns here are exactly the ones the serving path uses
+(try/finally, handler release, one-level funnels, guard-and-bail,
+ownership transfer by return)."""
+
+from tpuserve.genserve.arena import SlotArena
+from tpuserve.genserve.pages import PageLedger
+
+
+class Engine:
+    def __init__(self, slots, pages):
+        self.arena = SlotArena(slots)
+        self.pages = PageLedger(pages, 16)
+
+    async def bad_await_while_held(self, info):
+        slot = self.arena.acquire(info)
+        await self.insert(slot)  # an exception here leaks the slot
+        return slot
+
+    def bad_raise_while_held(self, info):
+        slot = self.arena.acquire(info)
+        if info is None:
+            raise ValueError("rejected")  # leaks: no handler releases
+        return slot
+
+    async def bad_call_while_held(self, info):
+        pages = self.pages.acquire(info.slot, info.n)
+        self.bookkeep(pages)  # any raise out of here leaks the pages
+        return pages
+
+    async def good_finally(self, info):
+        slot = self.arena.acquire(info)
+        try:
+            await self.insert(slot)
+        finally:
+            self.arena.release(slot)
+
+    async def good_handler_release(self, info):
+        slot = self.arena.acquire(info)
+        try:
+            await self.insert(slot)
+        except Exception:
+            self.arena.release(slot)
+            raise
+        return slot
+
+    async def good_release_funnel(self, info):
+        slot = self.arena.acquire(info)
+        try:
+            await self.insert(slot)
+        except Exception:
+            self._free(slot)  # one-level same-class funnel
+            raise
+        return slot
+
+    def _free(self, slot):
+        self.arena.release(slot)
+
+    def good_guard_and_bail(self, info):
+        slot = self.arena.acquire(info)
+        if info is None:
+            self.arena.release(slot)
+            return None
+        return slot  # ownership transfers to the caller
+
+    def good_immediate_return(self, info):
+        return self.arena.acquire(info)
+
+    async def good_sanctioned(self, info):
+        slot = self.arena.acquire(info)  # tps-ok[TPS601]: reaper releases
+        await self.insert(slot)
+        return slot
+
+    async def insert(self, slot):
+        pass
+
+    def bookkeep(self, pages):
+        pass

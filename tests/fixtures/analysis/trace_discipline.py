@@ -1,0 +1,129 @@
+"""TPS5xx positive/negative cases: trace-discipline hazards that
+reintroduce retrace churn or forced host transfers. Positive cases are
+``bad_*``; ``good_*`` must stay clean (the whole-tree gate depends on the
+rules not crying wolf on the repo's own idioms)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuserve.models.base import GenerativeModel
+
+
+# -- TPS502 / TPS503: host forcing + Python control flow in traced bodies --
+
+@jax.jit
+def bad_host_forcing(x):
+    v = float(jnp.sum(x))  # host-forcing float() on a traced value
+    s = x.mean()
+    s = s.item()  # host-forcing .item() (taint flows through .mean())
+    print("tracing")  # fires at trace time only
+    return np.log(x) + v + s  # np.* on a traced value
+
+
+@jax.jit
+def bad_traced_branch(x):
+    if jnp.sum(x) > 0:  # Python `if` on a traced value
+        x = -x
+    acc = x * 2
+    while jnp.any(acc > 0):  # Python `while` on a traced value
+        acc = acc - 1
+    return acc
+
+
+@jax.jit
+def good_static_reads(x, n: int):
+    if n > 3:  # int-annotated param: host-static by declaration
+        x = x * 2
+    if x.shape[0] > 1:  # shape is static trace-time metadata
+        x = x[:1]
+    if x is None:  # structural check, static under trace
+        return jnp.zeros(())
+    if len(x) > 2:  # len() of a tracer is its static leading dim
+        x = x[:2]
+    return jnp.sum(x)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def good_kwonly_static(x, *, mode="fast"):
+    if mode == "fast":  # kwonly args are the repo's static convention
+        return x * 2
+    return x
+
+
+@jax.jit
+def good_sanctioned(x):
+    if jnp.sum(x) > 0:  # tps-ok[TPS503]: fixture for the sanction filter
+        return x
+    return -x
+
+
+# -- TPS503 via the conventional-model entry points ------------------------
+
+class ToyGen(GenerativeModel):
+    def step(self, state):
+        done = jnp.all(state["done"])
+        if done:  # traced entry point by convention: Python `if` flagged
+            return state
+        return state
+
+
+# -- TPS501: per-call-fresh compile-cache entries --------------------------
+
+def scale_kernel(a, factor):
+    return a * factor
+
+
+def bad_jit_lambda(x):
+    f = jax.jit(lambda a: a * 2)  # fresh function object -> fresh entry
+    return f(x)
+
+
+def bad_jit_local_def(x):
+    def body(a):
+        return a + 1
+
+    g = jax.jit(body)  # local def: fresh per enclosing call
+    return g(x)
+
+
+def bad_fresh_static(x):
+    k = jax.jit(scale_kernel, static_argnames=("factor",))
+    return k(x, factor={"gain": 2.0})  # fresh dict in a static position
+
+
+def good_aot_local(x):
+    def body(a):
+        return a + 1
+
+    g = jax.jit(body)
+    return g.lower(x).compile()  # AOT-consumed: no dispatch cache
+
+
+# -- TPS504 / TPS505: retrace-by-closure -----------------------------------
+
+def bad_capture_arg(rt, n):
+    def stepper(state):
+        return state + n  # enclosing arg baked as a trace constant
+
+    rt.register_program("stepper", stepper)
+
+
+def bad_capture_fresh_array(rt, n):
+    table = jnp.arange(n)
+
+    def gather(state):
+        return state + table  # per-call array baked as a constant
+
+    rt.register_program("gather", gather)
+
+
+def good_pass_as_operand(rt, n):
+    table = jnp.arange(n)
+
+    def gather(state, tbl):
+        return state + tbl  # the table rides as a traced operand
+
+    rt.register_program("gather", gather, table)

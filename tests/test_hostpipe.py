@@ -317,7 +317,7 @@ def test_slotpool_acquire_release():
         s2 = await p.acquire()
         assert p.in_use == 2 and p.try_acquire() is None
         with pytest.raises(asyncio.TimeoutError):
-            await p.acquire(timeout=0.02)
+            await p.acquire(timeout_s=0.02)
         waiter = asyncio.ensure_future(p.acquire())
         await asyncio.sleep(0.01)
         p.release(s1)

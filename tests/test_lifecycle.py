@@ -366,6 +366,42 @@ def test_breaker_trip_in_soak_window_auto_rolls_back(loop):
     loop.run_until_complete(go())
 
 
+def test_failed_canary_in_soak_window_auto_rolls_back(loop):
+    """The other soak trigger: a failed periodic-canary verdict inside the
+    window reverts the publish and ticks rollbacks_total with reason
+    "soak_canary" (docs/REFERENCE.md)."""
+    cfg = toy_server_cfg(
+        lifecycle=LifecycleConfig(soak_s=5.0, soak_poll_s=0.05))
+    state = ServerState(cfg)
+    state.build()
+
+    async def go():
+        client = await _serving_client(state)
+        try:
+            r = await client.post("/admin/models/toy:reload")
+            assert r.status == 200, await r.text()
+            assert (await r.json())["version"] == 2
+            v = await (await client.get("/admin/models/toy/versions")).json()
+            assert v["soaking"] is True
+            # The periodic canary's verdict goes bad mid-soak; the soak
+            # monitor (not the breaker — it stays closed) must revert.
+            state.canary_ok["toy"] = False
+            deadline = time.perf_counter() + 3.0
+            while time.perf_counter() < deadline:
+                if state.runtimes["toy"].version == 1:
+                    break
+                await asyncio.sleep(0.02)
+            assert state.runtimes["toy"].version == 1, "soak did not roll back"
+            stats = await (await client.get("/stats")).json()
+            assert stats["counters"][
+                "rollbacks_total{model=toy,reason=soak_canary}"] == 1
+            assert stats["lifecycle"]["toy"]["soaking"] is False
+        finally:
+            await client.close()
+
+    loop.run_until_complete(go())
+
+
 def test_soak_window_passes_quietly(loop):
     """A healthy reload with a short soak window stays on the new version."""
     cfg = toy_server_cfg(lifecycle=LifecycleConfig(soak_s=0.2,

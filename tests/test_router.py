@@ -772,3 +772,52 @@ def test_router_error_bodies_carry_trace_id(fleet):
         assert state.recorder.get(headers["X-Trace-Id"]) is not None
 
     run(go())
+
+
+# ---------------------------------------------------------------------------
+# Stream termination reasons (TPS404 contract)
+# ---------------------------------------------------------------------------
+
+def test_stream_error_terminal_encodings():
+    """_stream_error_bytes builds the terminal the router appends when the
+    worker no longer can — SSE error event for text streams, a KIND_EVENT
+    frame for binary — naming the reason ("idle_timeout",
+    "upstream_error") that router_stream_terminated_total keys on."""
+    import json
+
+    from tpuserve import frame
+    from tpuserve.workerproc.router import _stream_error_bytes
+
+    sse = _stream_error_bytes("text/event-stream", "idle_timeout",
+                              "no bytes for 5000 ms")
+    assert sse.startswith(b"event: error\ndata: ")
+    assert sse.endswith(b"\n\n")
+    assert json.loads(sse.split(b"data: ", 1)[1]) == {
+        "error": "idle_timeout", "message": "no bytes for 5000 ms"}
+
+    raw = _stream_error_bytes(frame.CONTENT_TYPE, "upstream_error",
+                              "worker died")
+    events = list(frame.StreamFrameReader().feed(raw))
+    assert len(events) == 1
+    payload = json.loads(events[0][1])
+    assert payload == {"type": "error", "error": "upstream_error",
+                       "message": "worker died"}
+
+
+def test_router_termination_vocabulary_is_closed():
+    """The router's stream-termination counter is guarded by the closed
+    ROUTER_STREAM_REASONS vocabulary: "client_disconnect" and friends
+    tick; an off-list reason raises instead of minting a new label."""
+    import types
+
+    from tpuserve.obs import ROUTER_STREAM_REASONS, Metrics
+    from tpuserve.workerproc.router import RouterState
+
+    dummy = types.SimpleNamespace(metrics=Metrics())
+    for reason in ROUTER_STREAM_REASONS:
+        RouterState._count_stream_termination(dummy, "toy", reason)
+    assert dummy.metrics.counter(
+        "router_stream_terminated_total{model=toy,"
+        "reason=client_disconnect}").value == 1
+    with pytest.raises(ValueError, match="unknown stream-termination"):
+        RouterState._count_stream_termination(dummy, "toy", "freestyle")

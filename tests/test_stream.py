@@ -414,3 +414,51 @@ def test_injected_stream_disconnect_is_a_torn_stream():
             await client.close()
 
     run(go())
+
+
+# ---------------------------------------------------------------------------
+# Termination-reason vocabulary (TPS404 contract)
+# ---------------------------------------------------------------------------
+
+def test_engine_error_terminates_stream_with_reason(tg_rt):
+    """A step failure poisons the in-flight set (_fail_active): every
+    active stream gets the "engine_error" terminal and the reason is
+    counted under gen_stream_terminated_total — the label
+    docs/REFERENCE.md documents for engine-side faults."""
+    from tpuserve.faults import FaultInjector
+
+    model, _ = tg_rt
+    eng, m = make_engine(tg_rt)
+    eng.injector = FaultInjector.single("batch_error")
+
+    async def go():
+        await eng.start()
+        try:
+            fut, stream = eng.submit_stream(
+                prompt_item(model, "doomed", seed=7, max_new=8))
+            units = await drain_stream(stream)
+            terminal = units[-1]
+            assert terminal["type"] == "error"
+            assert terminal["error"] == "engine_error"
+            with pytest.raises(Exception):
+                await fut
+        finally:
+            await eng.stop()
+        assert m.counter("gen_stream_terminated_total{model=tg,"
+                         "reason=engine_error}").value >= 1
+
+    run(go())
+
+
+def test_engine_termination_vocabulary_is_closed(tg_rt):
+    """_count_termination refuses off-vocabulary reasons: a label an
+    operator can see on a dashboard must be one docs/REFERENCE.md
+    explains and a test exercises (TPS404) — ad-hoc strings would
+    fragment the metric and dodge both."""
+    from tpuserve.obs import GEN_STREAM_REASONS
+
+    eng, _ = make_engine(tg_rt)
+    for reason in GEN_STREAM_REASONS:
+        eng._count_termination(reason)  # every documented reason ticks
+    with pytest.raises(ValueError, match="unknown stream-termination"):
+        eng._count_termination("made_up_reason")

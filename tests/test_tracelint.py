@@ -1,0 +1,252 @@
+"""Trace-discipline (TPS5xx) and ledger-escape (TPS6xx) analysis suite:
+every rule against the fixture snippets (positive AND negative cases),
+the sanction filter, the whole repo tree staying clean with an EMPTY
+baseline, and the runtime retrace witness — a deliberate post-barrier
+compile raises RetraceViolation naming the (tag, variant) while the
+clean path stays silent with compile delta 0."""
+
+from pathlib import Path
+
+import pytest
+
+from tpuserve.analysis import ledgerlint, tracelint, witness
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def trace_fixture(name):
+    return tracelint.run_paths([FIXTURES / name], FIXTURES)
+
+
+def ledger_fixture(name):
+    return ledgerlint.run_paths([FIXTURES / name], FIXTURES)
+
+
+# ---------------------------------------------------------------------------
+# TPS501: per-call-fresh compile-cache entries
+# ---------------------------------------------------------------------------
+
+def test_jit_of_fresh_callable_flagged():
+    found = {(f.rule, f.symbol)
+             for f in trace_fixture("trace_discipline.py")}
+    assert ("TPS501", "bad_jit_lambda") in found
+    assert ("TPS501", "bad_jit_local_def") in found
+
+
+def test_fresh_literal_in_static_position_flagged():
+    hits = [f for f in trace_fixture("trace_discipline.py")
+            if f.rule == "TPS501" and f.symbol == "bad_fresh_static"]
+    assert hits and "static_argnames" in hits[0].message
+
+
+def test_aot_lower_compile_is_exempt():
+    assert not [f for f in trace_fixture("trace_discipline.py")
+                if f.symbol == "good_aot_local"]
+
+
+# ---------------------------------------------------------------------------
+# TPS502: host-forcing ops on traced values
+# ---------------------------------------------------------------------------
+
+def test_host_forcing_ops_flagged():
+    msgs = [f.message for f in trace_fixture("trace_discipline.py")
+            if f.rule == "TPS502" and f.symbol == "bad_host_forcing"]
+    assert any("float()" in m for m in msgs)
+    assert any(".item()" in m for m in msgs), \
+        "taint must flow through tracer method calls (x.mean())"
+    assert any("print()" in m for m in msgs)
+    assert any("np.log()" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# TPS503: Python control flow on traced values
+# ---------------------------------------------------------------------------
+
+def test_traced_branches_flagged():
+    msgs = [f.message for f in trace_fixture("trace_discipline.py")
+            if f.rule == "TPS503" and f.symbol == "bad_traced_branch"]
+    assert any("`if`" in m for m in msgs)
+    assert any("`while`" in m for m in msgs)
+
+
+def test_conventional_model_entry_point_is_traced():
+    hits = [f for f in trace_fixture("trace_discipline.py")
+            if f.rule == "TPS503" and f.symbol == "ToyGen.step"]
+    assert hits, "GenerativeModel.step must be in the jit-reachability set"
+
+
+def test_static_reads_and_kwonly_args_clean():
+    bad = [f for f in trace_fixture("trace_discipline.py")
+           if f.symbol in ("good_static_reads", "good_kwonly_static")]
+    assert not bad, [f.render() for f in bad]
+
+
+def test_sanction_annotation_filters_the_named_rule():
+    assert not [f for f in trace_fixture("trace_discipline.py")
+                if f.symbol == "good_sanctioned"]
+    # The annotation requires a reason and an exact rule match.
+    assert tracelint.sanctioned_rules(
+        "x = 1  # tps-ok[TPS503]: structure check") == {"TPS503"}
+    assert tracelint.sanctioned_rules(
+        "x = 1  # tps-ok[TPS501,TPS505]: factory") == {"TPS501", "TPS505"}
+    assert tracelint.sanctioned_rules("x = 1  # tps-ok[TPS503]:") == set()
+    assert tracelint.sanctioned_rules("x = 1  # tps-ok: because") == set()
+
+
+# ---------------------------------------------------------------------------
+# TPS504 / TPS505: retrace-by-closure
+# ---------------------------------------------------------------------------
+
+def test_closure_capture_of_enclosing_arg_flagged():
+    hits = [f for f in trace_fixture("trace_discipline.py")
+            if f.rule == "TPS505" and f.symbol == "bad_capture_arg"]
+    assert hits and "'n'" in hits[0].message
+
+
+def test_closure_capture_of_fresh_array_flagged():
+    hits = [f for f in trace_fixture("trace_discipline.py")
+            if f.rule == "TPS504" and f.symbol == "bad_capture_fresh_array"]
+    assert hits and "'table'" in hits[0].message
+
+
+def test_operand_passing_is_clean():
+    assert not [f for f in trace_fixture("trace_discipline.py")
+                if f.symbol == "good_pass_as_operand"]
+
+
+# ---------------------------------------------------------------------------
+# TPS601: ledger escape analysis
+# ---------------------------------------------------------------------------
+
+def test_ledger_escapes_flagged():
+    found = {(f.rule, f.symbol) for f in ledger_fixture("ledger_escape.py")}
+    assert ("TPS601", "Engine.bad_await_while_held") in found
+    assert ("TPS601", "Engine.bad_raise_while_held") in found
+    assert ("TPS601", "Engine.bad_call_while_held") in found
+
+
+def test_ledger_finding_names_both_sites():
+    hits = [f for f in ledger_fixture("ledger_escape.py")
+            if f.symbol == "Engine.bad_await_while_held"]
+    # Anchored at the acquire (where the sanction goes); the hazard line
+    # is named in the message.
+    assert hits[0].line == 17 and "(line 18)" in hits[0].message
+    assert "SlotArena 'arena'" in hits[0].message
+
+
+def test_ledger_protection_patterns_clean():
+    bad = [f for f in ledger_fixture("ledger_escape.py")
+           if "good_" in f.symbol]
+    assert not bad, [f.render() for f in bad]
+
+
+# ---------------------------------------------------------------------------
+# TPS101 descends into async generators (satellite of this family)
+# ---------------------------------------------------------------------------
+
+def test_async_generator_blocking_flagged():
+    from tpuserve.analysis import astlint
+
+    found = astlint.run_paths([FIXTURES / "async_gen.py"], FIXTURES)
+    assert any(f.rule == "TPS101" and f.symbol == "Streamer.bad_gen"
+               for f in found), [f.render() for f in found]
+    assert not [f for f in found if "good_" in f.symbol], \
+        [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# The real tree: both families must hold with an EMPTY baseline — every
+# in-repo finding was fixed or carries a reasoned inline sanction.
+# ---------------------------------------------------------------------------
+
+def test_repo_tree_clean_for_trace_and_ledger_rules():
+    from tpuserve.analysis import astlint
+    from tpuserve.analysis.findings import load_baseline
+
+    files = astlint.collect_files([ROOT / "tpuserve"])
+    findings = tracelint.run_paths(files, ROOT)
+    findings += ledgerlint.run_paths(files, ROOT)
+    assert not findings, \
+        "TPS5xx/TPS6xx findings in tree:\n" + "\n".join(
+            f.render() for f in findings)
+    baseline = load_baseline(ROOT / "tpuserve" / "analysis" / "baseline.json")
+    assert not baseline, "the TPS5xx/TPS6xx baseline must ship empty"
+
+
+# ---------------------------------------------------------------------------
+# Runtime retrace witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def armed_witness():
+    witness.force_retrace(True)
+    witness.reset_retrace()
+    yield
+    witness.force_retrace(None)
+    witness.reset_retrace()
+
+
+def test_retrace_registry_semantics(armed_witness):
+    # Pre-barrier compiles are warmup: counted, silent.
+    witness.note_compile("tg", "b4/float32/none/single")
+    witness.declare_warmup_complete()
+    # Sanctioned window (lifecycle ensure_compiled): counted, silent.
+    with witness.sanctioned_compiles():
+        witness.note_compile("tg", "b8/float32/none/single")
+    # Anything else after the barrier raises, naming (tag, variant).
+    with pytest.raises(witness.RetraceViolation) as ei:
+        witness.note_compile("tg", "b16/float32/none/single")
+    assert "tag=tg" in str(ei.value)
+    assert "b16/float32/none/single" in str(ei.value)
+    snap = witness.retrace_snapshot()
+    assert snap["enabled"] and snap["barrier_declared"]
+    assert snap["warmup_compiles"] == 1
+    assert snap["sanctioned_compiles"] == 1
+    assert len(snap["violations"]) == 1
+    assert snap["violations"][0]["tag"] == "tg"
+    assert snap["violations"][0]["variant"] == "b16/float32/none/single"
+
+
+def test_retrace_witness_end_to_end_on_runtime(armed_witness):
+    """A real ModelRuntime: warmup compiles are silent, the clean path
+    re-ensures with compile delta 0, and a deliberate post-barrier bucket
+    compile raises through the runtime's own compile site."""
+    from tpuserve.config import ModelConfig
+    from tpuserve.models import build
+    from tpuserve.runtime import build_runtime
+
+    cfg = ModelConfig(name="toy", family="toy", batch_buckets=[1],
+                      dtype="float32", num_classes=10, parallelism="single")
+    model = build(cfg)
+    rt = build_runtime(model)  # warmup: compiles bucket (1,) silently
+    witness.declare_warmup_complete()
+
+    before = rt.compiles_total
+    assert rt.ensure_compiled() == 0  # steady state: compile delta 0
+    assert rt.compiles_total == before
+    assert not witness.retrace_snapshot()["violations"]
+
+    # Deliberate retrace: a bucket appears after the barrier.
+    cfg.batch_buckets.append(2)
+    with pytest.raises(witness.RetraceViolation) as ei:
+        rt.ensure_compiled()
+    assert "tag=toy" in str(ei.value)
+    viol = witness.retrace_snapshot()["violations"][0]
+    assert viol["tag"] == "toy"
+    assert viol["variant"].split("/")[0] == "2"  # the (2,) bucket
+    # The compile counter ticked BEFORE the raise: ledger and witness
+    # agree on what happened.
+    assert rt.compiles_total == before + 1
+
+
+def test_retrace_witness_disabled_is_inert():
+    witness.force_retrace(False)
+    try:
+        witness.reset_retrace()
+        witness.declare_warmup_complete()
+        witness.note_compile("tg", "b4/float32/none/single")  # no raise
+        assert witness.retrace_snapshot()["violations"] == []
+    finally:
+        witness.force_retrace(None)
+        witness.reset_retrace()

@@ -2,8 +2,13 @@
 
 - ``tpuserve.analysis.astlint`` — AST rule families over the serving path
   (blocking-in-async, lock-order cycles, unguarded cross-thread writes).
+- ``tpuserve.analysis.tracelint`` — TPS5xx trace discipline over the
+  jit-reachability set (retrace/recompile/host-transfer hazards).
+- ``tpuserve.analysis.ledgerlint`` — TPS6xx acquire/release escape
+  analysis over the four resource ledgers.
 - ``tpuserve.analysis.drift`` — docs/config/test drift rules.
-- ``tpuserve.analysis.witness`` — TPUSERVE_LOCK_WITNESS=1 runtime witness.
+- ``tpuserve.analysis.witness`` — TPUSERVE_LOCK_WITNESS=1 lock-order and
+  TPUSERVE_RETRACE_WITNESS=1 compile-stability runtime witnesses.
 - ``tpuserve.analysis.cli`` — ``python -m tpuserve lint`` entry point, with
   the checked-in baseline at ``tpuserve/analysis/baseline.json``.
 

@@ -195,6 +195,31 @@ def _walk_skipping_defs(node: ast.AST):
         stack.extend(ast.iter_child_nodes(n))
 
 
+def _collect_nested(mi: ModuleInfo, owner: FuncInfo) -> None:
+    """Register defs nested inside ``owner`` under ``<locals>`` qualnames.
+
+    Async generators defined inside handler functions (PR 17's streaming
+    bodies) run ON the event loop when iterated, but used to be invisible:
+    only top-level and class-level defs were collected, so the blocking-call
+    rules never saw them. The ``<locals>`` qualname keeps them out of the
+    bare-name resolution map (``_resolve_call`` looks up ``f`` or
+    ``Cls.f``), so they are checked directly without becoming accidental
+    call-graph targets."""
+    for sub in ast.walk(owner.node):
+        if sub is owner.node:
+            continue
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        fi = FuncInfo(
+            mi.modname,
+            owner.cls,
+            f"{owner.name}.<locals>.{sub.name}",
+            sub,
+            isinstance(sub, ast.AsyncFunctionDef),
+        )
+        mi.functions.setdefault(fi.qualname, fi)
+
+
 def _parse_module(path: Path, root: Path) -> ModuleInfo | None:
     try:
         src = path.read_text()
@@ -232,6 +257,8 @@ def _parse_module(path: Path, root: Path) -> ModuleInfo | None:
                         isinstance(item, ast.AsyncFunctionDef),
                     )
                     mi.functions[fi.qualname] = fi
+    for fi in list(mi.functions.values()):
+        _collect_nested(mi, fi)
     return mi
 
 
@@ -304,6 +331,10 @@ class Analyzer:
         for n in _walk_skipping_defs(fi.node):
             if isinstance(n, ast.Await):
                 awaited.add(id(n.value))
+        parents: dict[int, ast.AST] = {}
+        for p in ast.walk(fi.node):
+            for c in ast.iter_child_nodes(p):
+                parents[id(c)] = p
         # Direct blocking primitives + loop-only smells in the async body.
         for desc, line in self._direct_blocking(fi.node, awaited):
             self._add("TPS101", mi, fi.qualname, f"blocking call {desc} in async def", line)
@@ -312,6 +343,8 @@ class Analyzer:
                 continue
             if isinstance(n.func, ast.Attribute):
                 if n.func.attr in ASYNC_ONLY_ATTRS and not n.args and not n.keywords:
+                    if self._done_guarded(parents, n):
+                        continue  # t.result() under `if t.done():` — no wait
                     self._add(
                         "TPS101",
                         mi,
@@ -331,6 +364,29 @@ class Analyzer:
                         )
         # Propagate through directly-called sync helpers (bounded DFS).
         self._reach_blocking(mi, fi, fi.node, awaited, [fi.qualname], set())
+
+    @staticmethod
+    def _done_guarded(parents: dict[int, ast.AST], call: ast.Call) -> bool:
+        """True for ``t.result()`` inside the body of ``if t.done():`` — the
+        task already completed, so the read cannot block the loop."""
+        if call.func.attr != "result":
+            return False
+        recv = dotted(call.func.value)
+        if recv is None:
+            return False
+        child: ast.AST = call
+        n: ast.AST = call
+        while id(n) in parents:
+            child, n = n, parents[id(n)]
+            if not isinstance(n, ast.If) or child not in n.body:
+                continue
+            for sub in ast.walk(n.test):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "done" \
+                        and dotted(sub.func.value) == recv:
+                    return True
+        return False
 
     def _reach_blocking(self, mi, fi, node, awaited, path, seen) -> None:
         if len(path) > MAX_CALL_DEPTH:

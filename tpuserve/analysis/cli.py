@@ -13,7 +13,7 @@ import json
 import sys
 from pathlib import Path
 
-from tpuserve.analysis import astlint, drift
+from tpuserve.analysis import astlint, drift, ledgerlint, tracelint
 from tpuserve.analysis.findings import compare, load_baseline, save_baseline
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -39,7 +39,10 @@ def run_lint(args: argparse.Namespace) -> int:
         if not p.exists():
             print(f"lint: no such path: {p}", file=sys.stderr)
             return 2
-    findings = astlint.run_paths(astlint.collect_files(paths), root)
+    files = astlint.collect_files(paths)
+    findings = astlint.run_paths(files, root)
+    findings += tracelint.run_paths(files, root)
+    findings += ledgerlint.run_paths(files, root)
     if not args.no_drift:
         findings += drift.run(root)
 

@@ -13,6 +13,13 @@ a chaos fault kind no test references is recovery machinery nobody proves.
   call sites) appears in the docs corpus.
 - **TPS403** — every fault kind in ``config.FAULT_KINDS`` is referenced by
   at least one file under ``tests/``.
+- **TPS404** — every shed/terminal reason string in the closed label
+  vocabularies (``SCHED_SHED_REASONS``, ``TENANT_SHED_REASONS``,
+  ``GEN_STREAM_REASONS``, ``ROUTER_STREAM_REASONS``, ``ROLLBACK_REASONS``
+  in ``tpuserve/obs.py``) appears in docs/REFERENCE.md AND is referenced
+  by at least one file under ``tests/`` — the same contract TPS403 gives
+  fault kinds. A reason an operator can see on a dashboard must be a
+  reason the docs explain and a test exercises.
 
 Everything is pure text/AST scanning — no tpuserve imports — so the lint CI
 job runs on a bare Python install.
@@ -67,6 +74,30 @@ def fault_kinds(config_py: Path) -> list[str]:
                     if isinstance(el, ast.Constant) and isinstance(el.value, str)
                 ]
     return []
+
+
+# The closed reason vocabularies (module-level tuples in tpuserve/obs.py)
+# TPS404 holds to the docs+tests contract.
+REASON_VOCABULARIES = ("SCHED_SHED_REASONS", "TENANT_SHED_REASONS",
+                       "GEN_STREAM_REASONS", "ROUTER_STREAM_REASONS",
+                       "ROLLBACK_REASONS")
+
+
+def reason_vocabularies(obs_py: Path) -> list[tuple[str, str]]:
+    """(vocabulary tuple name, reason string) for every entry of the
+    REASON_VOCABULARIES tuples in obs.py."""
+    tree = ast.parse(obs_py.read_text())
+    out: list[tuple[str, str]] = []
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        t = stmt.targets[0]
+        if not isinstance(t, ast.Name) or t.id not in REASON_VOCABULARIES:
+            continue
+        for el in getattr(stmt.value, "elts", ()):
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append((t.id, el.value))
+    return out
 
 
 _METRIC_RE = re.compile(
@@ -132,4 +163,24 @@ def run(root: Path) -> list[Finding]:
                     message="fault kind has no test referencing it under tests/",
                 )
             )
+
+    obs_py = root / "tpuserve" / "obs.py"
+    reference = _read_all([root / "docs" / "REFERENCE.md"])
+    if obs_py.exists():
+        for vocab, reason in reason_vocabularies(obs_py):
+            missing = []
+            if not _token_in(reason, reference):
+                missing.append("docs/REFERENCE.md")
+            if not _token_in(reason, tests):
+                missing.append("tests/")
+            if missing:
+                findings.append(
+                    Finding(
+                        rule="TPS404",
+                        file="tpuserve/obs.py",
+                        symbol=f"reason.{vocab}.{reason}",
+                        message=("shed/terminal reason not covered by: "
+                                 + ", ".join(missing)),
+                    )
+                )
     return findings

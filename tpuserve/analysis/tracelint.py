@@ -1,0 +1,716 @@
+"""Trace-discipline lint (TPS5xx) — static retrace/transfer hazards.
+
+The repo's central invariant is "steady-state compile delta 0": every smoke
+and drill gates on ``runtime_compiles_total`` staying flat after warmup.
+These rules prove the obligation *ahead* of runtime by walking the
+jit-reachability set — every function whose body JAX traces — and flagging
+the hazard classes that silently reintroduce retrace churn or forced
+host transfers:
+
+- **TPS501 — per-call compile-cache entries.** ``jax.jit`` applied to a
+  lambda or a function defined in the enclosing call (a fresh function
+  object per invocation → a fresh cache entry per invocation), unless the
+  result is AOT-consumed (``.lower(...).compile()`` — the repo's own
+  bucket-compile idiom, which never relies on the dispatch cache). Also a
+  call of a jitted function passing a fresh dict/list/set literal or a
+  lambda in a ``static_argnums``/``static_argnames`` position —
+  non-hashable statics raise, fresh hashables mint an entry per call.
+
+- **TPS502 — host-forcing ops on traced values.** ``.item()`` /
+  ``.tolist()``, ``float()`` / ``int()`` / ``bool()``, and ``np.*`` calls
+  on tracer-typed names inside a traced body force a device sync +
+  transfer at trace or dispatch time; bare ``print`` in a traced body
+  fires at trace time only (use ``jax.debug.print``).
+
+- **TPS503 — Python control flow on traced values.** ``if``/``while`` on a
+  tracer-derived expression inside a traced body raises
+  ``TracerBoolConversionError`` at best and bakes a trace-time constant at
+  worst. ``x is None`` checks and kwonly-arg branches are exempt (both are
+  static by construction — kwonly args of traced functions are the repo's
+  convention for compile-time parameters, e.g. ``prefill_chunk``'s
+  ``chunk``).
+
+- **TPS504 / TPS505 — retrace-by-closure.** In a *host-side* function, a
+  nested function handed to ``jax.jit`` / ``register_program`` that
+  captures (TPS504) an array freshly built per call from the enclosing
+  function's arguments (``jnp.arange(n)`` and friends) or (TPS505) an
+  enclosing-function argument directly — the captured value is baked into
+  the trace as a constant, so every distinct value recompiles. Pass it as
+  a traced argument instead. Traced enclosing functions are exempt
+  (capturing a tracer into a ``fori_loop`` body is the normal idiom).
+
+Jit-reachability = functions decorated with / passed to ``jax.jit``, the
+second argument of ``register_program(tag, fn, ...)`` calls, the
+conventional GenerativeModel/ServingModel entry points (``forward``,
+``step``, ``extract``, ``init_state``, ``prefill_chunk``), plus a bounded
+same-module call-graph walk through their helpers (nested defs included —
+``fori_loop``/``scan`` bodies are checked as part of their enclosing
+traced body).
+
+Deliberate host reads carry an inline sanction::
+
+    if "kp" in state:  # tps-ok[TPS503]: pytree structure check at trace time
+
+The annotation names the rule and MUST give a reason; it suppresses that
+rule on that statement only (docs/ANALYSIS.md "Sanctioned patterns").
+
+Honest limits: resolution is name-based within a module/class (no type
+inference), so cross-object helpers (``self.unet.apply``) are not
+descended into, and a model whose bucket set varies per call defeats the
+static view — that residue is exactly what the runtime retrace witness
+(``TPUSERVE_RETRACE_WITNESS=1``) covers.
+
+Pure AST + text — no jax import — so the bare-Python CI lint job runs it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from tpuserve.analysis.astlint import (
+    MAX_CALL_DEPTH,
+    FuncInfo,
+    ModuleInfo,
+    _parse_module,
+    _self_attr,
+    dotted,
+)
+from tpuserve.analysis.findings import Finding
+
+# Conventional traced entry points on serving/generative model classes.
+TRACED_METHOD_NAMES = {"forward", "step", "extract", "init_state",
+                       "prefill_chunk"}
+TRACED_BASE_NAMES = {"GenerativeModel", "ServingModel"}
+
+# Attribute reads that yield static (trace-time Python) values even on a
+# tracer: branching on a shape is free, branching on data is not.
+UNTAINT_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+# Builtins that force a concrete host value out of a tracer.
+HOST_FORCERS = {"float", "int", "bool", "complex"}
+HOST_FORCER_ATTRS = {"item", "tolist"}
+
+# Untainting builtins: static under trace (len of a tracer is its static
+# leading dim; isinstance/type are structural).
+STATIC_BUILTINS = {"len", "isinstance", "type", "range", "enumerate"}
+
+# Array constructors whose per-call result, captured into a traced body,
+# bakes a fresh constant (TPS504).
+ARRAY_BUILDERS = {"arange", "zeros", "ones", "full", "asarray", "array",
+                  "linspace", "eye", "tri"}
+ARRAY_NAMESPACES = {"jnp", "np", "numpy", "jax.numpy"}
+
+_SANCTION_RE = re.compile(
+    r"#\s*tps-ok\[(?P<rules>TPS\d{3}(?:\s*,\s*TPS\d{3})*)\]:\s*\S")
+
+
+def sanctioned_rules(line_text: str) -> set[str]:
+    """Rule ids sanctioned by an inline ``# tps-ok[TPSnnn]: reason``
+    annotation on this source line (empty set when absent or when the
+    required reason text is missing)."""
+    m = _SANCTION_RE.search(line_text)
+    if m is None:
+        return set()
+    return {r.strip() for r in m.group("rules").split(",")}
+
+
+def filter_sanctioned(findings: list[Finding],
+                      sources: dict[str, list[str]]) -> list[Finding]:
+    """Drop findings whose source line carries a matching sanction."""
+    out = []
+    for f in findings:
+        lines = sources.get(f.file)
+        if lines and 1 <= f.line <= len(lines) \
+                and f.rule in sanctioned_rules(lines[f.line - 1]):
+            continue
+        out.append(f)
+    return out
+
+
+def _is_jit_name(name: str | None) -> bool:
+    return name is not None and (name == "jit" or name.endswith(".jit"))
+
+
+def _jit_decorator(dec: ast.AST) -> ast.Call | None:
+    """The decorator as a pseudo jit Call when it is ``@jax.jit`` /
+    ``@jit`` / ``@functools.partial(jax.jit, ...)``, else None."""
+    if _is_jit_name(dotted(dec)):
+        return ast.Call(func=dec, args=[], keywords=[])
+    if isinstance(dec, ast.Call):
+        if _is_jit_name(dotted(dec.func)):
+            return dec
+        if dotted(dec.func) in ("functools.partial", "partial") and dec.args \
+                and _is_jit_name(dotted(dec.args[0])):
+            return ast.Call(func=dec.args[0], args=[], keywords=dec.keywords)
+    return None
+
+
+def _static_names(jit_call: ast.Call) -> tuple[set[str], set[int]]:
+    """(static_argnames, static_argnums) literal values on a jit call."""
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    return names, nums
+
+
+def _fresh_literal(node: ast.AST) -> str | None:
+    """A per-call-fresh / non-hashable literal in a static position."""
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict literal"
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list literal"
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "set literal"
+    if isinstance(node, ast.Lambda):
+        return "lambda"
+    return None
+
+
+def _positional_params(fn: ast.AST) -> list[str]:
+    """Positional parameter names, minus self/cls. Kwonly args are NOT
+    included: a kwonly arg of a traced function is this repo's convention
+    for a compile-time-static parameter (closed over at register time)."""
+    a = fn.args
+    names = [p.arg for p in [*a.posonlyargs, *a.args]]
+    return [n for n in names if n not in ("self", "cls")]
+
+
+def _static_param_names(fn: ast.AST) -> set[str]:
+    """Params that are host-static by declaration — annotated with a host
+    scalar type (``b: int``), or listed in a ``custom_vjp``/``custom_jvp``
+    ``nondiff_argnums`` (JAX hands those to the function as Python
+    values, not tracers)."""
+    static: set[str] = set()
+    pos = [*fn.args.posonlyargs, *fn.args.args]
+    for p in pos:
+        ann = p.annotation
+        if isinstance(ann, ast.Name) and ann.id in ("int", "bool", "str"):
+            static.add(p.arg)
+    for dec in getattr(fn, "decorator_list", ()):
+        if not isinstance(dec, ast.Call):
+            continue
+        name = dotted(dec.func) or ""
+        is_custom = name.split(".")[-1] in ("custom_vjp", "custom_jvp")
+        if not is_custom and name in ("functools.partial", "partial") \
+                and dec.args:
+            inner = (dotted(dec.args[0]) or "").split(".")[-1]
+            is_custom = inner in ("custom_vjp", "custom_jvp")
+        if not is_custom:
+            continue
+        for kw in dec.keywords:
+            if kw.arg != "nondiff_argnums":
+                continue
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                        and 0 <= n.value < len(pos):
+                    static.add(pos[n.value].arg)
+    return static
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Every name bound inside ``fn`` (params, assignments, defs, loop and
+    comprehension targets, imports) — for free-variable computation."""
+    a = fn.args
+    bound = {p.arg for p in [*a.posonlyargs, *a.args, *a.kwonlyargs]}
+    if a.vararg:
+        bound.add(a.vararg.arg)
+    if a.kwarg:
+        bound.add(a.kwarg.arg)
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            bound.add(n.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(n.name)
+        elif isinstance(n, ast.alias):
+            bound.add((n.asname or n.name).split(".")[0])
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            bound.add(n.name)
+    return bound
+
+
+def _free_names(fn: ast.AST) -> set[str]:
+    """Names ``fn`` reads but does not bind (its closure candidates)."""
+    bound = _bound_names(fn)
+    free = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id not in bound:
+            free.add(n.id)
+    return free
+
+
+class _Taint:
+    """Flow-through taint for tracer-typed names inside one traced body.
+
+    Positional params seed the set; values computed from tainted names or
+    from ``jnp.*``/``jax.*`` calls propagate; ``.shape``/``.dtype``-style
+    reads, ``len()``, and ``x is None`` checks untaint (static at trace
+    time)."""
+
+    def __init__(self, seed: set[str]) -> None:
+        self.names = set(seed)
+
+    def expr(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.names
+        if isinstance(e, ast.Attribute):
+            if e.attr in UNTAINT_ATTRS:
+                return False
+            return self.expr(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.expr(e.value)
+        if isinstance(e, ast.Call):
+            name = dotted(e.func) or ""
+            if isinstance(e.func, ast.Name) and e.func.id in STATIC_BUILTINS:
+                return False
+            if isinstance(e.func, ast.Name) and e.func.id in HOST_FORCERS:
+                return False  # result is a host scalar (and flagged)
+            if name.split(".")[-1] == "typeof":
+                return False  # avals are static trace-time metadata
+            if name.split(".")[0] in ("jnp", "jax"):
+                return True
+            if isinstance(e.func, ast.Attribute):
+                if e.func.attr in HOST_FORCER_ATTRS:
+                    return False  # result is a host value (and flagged)
+                if self.expr(e.func.value):
+                    return True  # method on a tracer (x.mean(), x.sum())
+            return any(self.expr(a) for a in e.args) or any(
+                self.expr(kw.value) for kw in e.keywords)
+        if isinstance(e, ast.BinOp):
+            return self.expr(e.left) or self.expr(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops) \
+                    and any(isinstance(c, ast.Constant) and c.value is None
+                            for c in e.comparators):
+                return False  # `x is None`: structural, static under trace
+            return self.expr(e.left) or any(self.expr(c)
+                                            for c in e.comparators)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(v) for v in e.elts)
+        if isinstance(e, (ast.GeneratorExp, ast.ListComp, ast.SetComp)):
+            return self.expr(e.elt)
+        if isinstance(e, ast.IfExp):
+            return self.expr(e.body) or self.expr(e.test) or self.expr(e.orelse)
+        if isinstance(e, ast.Starred):
+            return self.expr(e.value)
+        if isinstance(e, ast.Await):
+            return self.expr(e.value)
+        return False
+
+    def assign(self, target: ast.AST, value_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if value_tainted:
+                self.names.add(target.id)
+            else:
+                self.names.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                self.assign(t, value_tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, value_tainted)
+
+
+class TraceAnalyzer:
+    """TPS5xx driver over a parsed module set."""
+
+    def __init__(self, modules: list[ModuleInfo]) -> None:
+        self.modules = modules
+        self.findings: list[Finding] = []
+        self.traced: set[tuple[str, str]] = set()  # (modname, qualname)
+        # Method names handed to register_program through an object we
+        # cannot type (``rt.register_program("step", model.step)``) — any
+        # conventional model class defining them is treated as traced.
+        self._traced_attr_names: set[str] = set(TRACED_METHOD_NAMES)
+
+    # -- reachability ---------------------------------------------------------
+
+    def _conventional_classes(self, mi: ModuleInfo) -> set[str]:
+        out = set()
+        for stmt in mi.tree.body:
+            if not isinstance(stmt, ast.ClassDef):
+                continue
+            for base in stmt.bases:
+                name = dotted(base) or ""
+                if name.split(".")[-1] in TRACED_BASE_NAMES:
+                    out.add(stmt.name)
+        return out
+
+    def _mark(self, mi: ModuleInfo, fi: FuncInfo) -> None:
+        self.traced.add((mi.modname, fi.qualname))
+
+    def _seed_roots(self) -> None:
+        for mi in self.modules:
+            conv = self._conventional_classes(mi)
+            for fi in mi.functions.values():
+                node = fi.node
+                bare = fi.name.split(".")[-1]
+                if fi.cls in conv and bare in TRACED_METHOD_NAMES \
+                        and "<locals>" not in fi.name:
+                    self._mark(mi, fi)
+                for dec in getattr(node, "decorator_list", ()):
+                    if _jit_decorator(dec) is not None:
+                        self._mark(mi, fi)
+            # Functions passed (by reference) to jit / register_program.
+            for fi in list(mi.functions.values()):
+                for n in ast.walk(fi.node):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    fn_arg = None
+                    if _is_jit_name(dotted(n.func)) and n.args:
+                        fn_arg = n.args[0]
+                    elif isinstance(n.func, ast.Attribute) \
+                            and n.func.attr == "register_program" \
+                            and len(n.args) >= 2:
+                        fn_arg = n.args[1]
+                    if fn_arg is None:
+                        continue
+                    self._mark_reference(mi, fi, fn_arg)
+
+    def _mark_reference(self, mi: ModuleInfo, scope: FuncInfo,
+                        ref: ast.AST) -> None:
+        if isinstance(ref, ast.Name):
+            # A local def of the enclosing function (registered under a
+            # ``<locals>`` qualname by astlint), else a module-level def.
+            suffix = f".<locals>.{ref.id}"
+            local = next(
+                (f for q, f in mi.functions.items()
+                 if q.endswith(suffix) and q.startswith(
+                     scope.qualname.split(".<locals>.")[0])),
+                None)
+            target = local or mi.functions.get(ref.id)
+            if target is not None:
+                self._mark(mi, target)
+            return
+        attr = _self_attr(ref)
+        if attr is not None and scope.cls is not None:
+            target = mi.functions.get(f"{scope.cls}.{attr}")
+            if target is not None:
+                self._mark(mi, target)
+            return
+        if isinstance(ref, ast.Attribute):
+            # ``model.step``-style: type unknown; remember the method name
+            # and mark it on every conventional model class.
+            self._traced_attr_names.add(ref.attr)
+
+    def _walk_reachability(self) -> None:
+        # Conventional-class methods named like recorded attr references.
+        for mi in self.modules:
+            conv = self._conventional_classes(mi)
+            for fi in mi.functions.values():
+                if fi.cls in conv and fi.name in self._traced_attr_names \
+                        and "<locals>" not in fi.name:
+                    self._mark(mi, fi)
+        # Bounded same-module call-graph closure.
+        work = [(mi, fi, 1) for mi in self.modules
+                for fi in list(mi.functions.values())
+                if (mi.modname, fi.qualname) in self.traced]
+        while work:
+            mi, fi, depth = work.pop()
+            if depth > MAX_CALL_DEPTH:
+                continue
+            for n in ast.walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = None
+                attr = _self_attr(n.func)
+                if attr is not None and fi.cls is not None:
+                    callee = mi.functions.get(f"{fi.cls}.{attr}")
+                elif isinstance(n.func, ast.Name):
+                    callee = mi.functions.get(n.func.id)
+                if callee is None:
+                    continue
+                key = (mi.modname, callee.qualname)
+                if key in self.traced:
+                    continue
+                self.traced.add(key)
+                work.append((mi, callee, depth + 1))
+
+    # -- rules ----------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self._seed_roots()
+        self._walk_reachability()
+        for mi in self.modules:
+            top = [fi for fi in mi.functions.values()
+                   if "<locals>" not in fi.name]
+            for fi in top:
+                if (mi.modname, fi.qualname) in self.traced:
+                    self._check_traced_body(mi, fi)
+                else:
+                    self._check_closure_capture(mi, fi)
+                self._check_jit_sites(mi, fi)
+        self.findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
+        return self.findings
+
+    # TPS502 / TPS503 over one traced body (nested defs included: a
+    # fori_loop/scan body is part of the trace).
+    def _check_traced_body(self, mi: ModuleInfo, fi: FuncInfo) -> None:
+        taint = _Taint(set(_positional_params(fi.node))
+                       - _static_param_names(fi.node))
+
+        def visit_stmt(stmt: ast.AST) -> None:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for p in set(_positional_params(stmt)) \
+                        - _static_param_names(stmt):
+                    taint.names.add(p)
+                for s in stmt.body:
+                    visit_stmt(s)
+                return
+            if isinstance(stmt, ast.Assign):
+                t = taint.expr(stmt.value)
+                self._check_exprs(mi, fi, taint, stmt)
+                for target in stmt.targets:
+                    taint.assign(target, t)
+                return
+            if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                t = taint.expr(value) if value is not None else False
+                if isinstance(stmt, ast.AugAssign):
+                    t = t or taint.expr(stmt.target)
+                self._check_exprs(mi, fi, taint, stmt)
+                taint.assign(stmt.target, t)
+                return
+            if isinstance(stmt, (ast.If, ast.While)):
+                if taint.expr(stmt.test):
+                    kind = "if" if isinstance(stmt, ast.If) else "while"
+                    self._add(
+                        "TPS503", mi, fi,
+                        f"Python `{kind}` on traced value "
+                        f"{ast.unparse(stmt.test)} (trace-time branch; use "
+                        "jnp.where / lax.cond)", stmt.lineno)
+                self._check_exprs(mi, fi, taint, stmt.test)
+                for s in [*stmt.body, *stmt.orelse]:
+                    visit_stmt(s)
+                return
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_exprs(mi, fi, taint, stmt.iter)
+                taint.assign(stmt.target, taint.expr(stmt.iter))
+                for s in [*stmt.body, *stmt.orelse]:
+                    visit_stmt(s)
+                return
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for s in stmt.body:
+                    visit_stmt(s)
+                return
+            if isinstance(stmt, ast.Try):
+                for s in [*stmt.body, *stmt.orelse, *stmt.finalbody]:
+                    visit_stmt(s)
+                for h in stmt.handlers:
+                    for s in h.body:
+                        visit_stmt(s)
+                return
+            self._check_exprs(mi, fi, taint, stmt)
+
+        for s in fi.node.body:
+            visit_stmt(s)
+
+    def _check_exprs(self, mi: ModuleInfo, fi: FuncInfo, taint: _Taint,
+                     node: ast.AST) -> None:
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            name = dotted(n.func) or ""
+            if isinstance(n.func, ast.Name):
+                if n.func.id in HOST_FORCERS and len(n.args) == 1 \
+                        and taint.expr(n.args[0]):
+                    self._add(
+                        "TPS502", mi, fi,
+                        f"host-forcing {n.func.id}() on traced value "
+                        f"{ast.unparse(n.args[0])}", n.lineno)
+                elif n.func.id == "print":
+                    self._add(
+                        "TPS502", mi, fi,
+                        "print() in traced body fires at trace time only "
+                        "(use jax.debug.print)", n.lineno)
+            if isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in HOST_FORCER_ATTRS \
+                    and not n.args and taint.expr(n.func.value):
+                self._add(
+                    "TPS502", mi, fi,
+                    f"host-forcing .{n.func.attr}() on traced value "
+                    f"{ast.unparse(n.func.value)}", n.lineno)
+            if name.split(".")[0] in ("np", "numpy") and (
+                    any(taint.expr(a) for a in n.args)
+                    or any(taint.expr(kw.value) for kw in n.keywords)):
+                self._add(
+                    "TPS502", mi, fi,
+                    f"{name}() on traced value forces a host transfer "
+                    "(use jnp)", n.lineno)
+
+    # TPS501 over one function's jit sites.
+    def _check_jit_sites(self, mi: ModuleInfo, fi: FuncInfo) -> None:
+        node = fi.node
+        # jitted-name -> its static argnames/argnums, to vet call sites.
+        statics: dict[str, tuple[set[str], set[int]]] = {}
+        for dec in getattr(node, "decorator_list", ()):
+            jc = _jit_decorator(dec)
+            if jc is not None:
+                statics[node.name.split(".")[-1]] = _static_names(jc)
+        aot_names = set()
+        jit_assigns: list[tuple[str, ast.Call]] = []
+        local_defs = {n.name for n in ast.walk(node)
+                      if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                      and n is not node}
+        parents: dict[int, ast.AST] = {}
+        for p in ast.walk(node):
+            for c in ast.iter_child_nodes(p):
+                parents[id(c)] = p
+        for n in ast.walk(node):
+            if isinstance(n, ast.Attribute) and n.attr == "lower" \
+                    and isinstance(n.value, ast.Name):
+                aot_names.add(n.value.id)
+            if not isinstance(n, ast.Call) or not _is_jit_name(dotted(n.func)):
+                continue
+            par = parents.get(id(n))
+            if isinstance(par, ast.Attribute) and par.attr == "lower":
+                continue  # jax.jit(...).lower(...): AOT, no dispatch cache
+            assigned = None
+            if isinstance(par, ast.Assign) and len(par.targets) == 1 \
+                    and isinstance(par.targets[0], ast.Name):
+                assigned = par.targets[0].id
+            if n.args and fi.name.split(".")[-1] != "__init__":
+                arg0 = n.args[0]
+                if isinstance(arg0, ast.Lambda) or (
+                        isinstance(arg0, ast.Name) and arg0.id in local_defs):
+                    # Verdict deferred: aot_names fills as the walk runs.
+                    jit_assigns.append((assigned or "", n))
+            if assigned is not None:
+                statics[assigned] = _static_names(n)
+        # Re-check fresh-callable jit sites now that aot_names is complete.
+        for assigned, call in jit_assigns:
+            if assigned and assigned in aot_names:
+                continue
+            arg0 = call.args[0]
+            what = ("a lambda" if isinstance(arg0, ast.Lambda)
+                    else f"locally-defined {ast.unparse(arg0)}")
+            self._add(
+                "TPS501", mi, fi,
+                f"jax.jit({what}) mints a fresh compile-cache entry per "
+                "call (hoist the function, or AOT-compile via "
+                ".lower().compile())", call.lineno)
+        # Call sites of jitted names: fresh/non-hashable statics.
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            base = dotted(n.func)
+            if base is None or base.split(".")[-1] not in statics:
+                continue
+            names, nums = statics[base.split(".")[-1]]
+            for i, a in enumerate(n.args):
+                lit = _fresh_literal(a)
+                if lit and i in nums:
+                    self._add(
+                        "TPS501", mi, fi,
+                        f"{lit} passed in static_argnums position {i} of "
+                        f"{base}() (non-hashable / fresh per call)",
+                        n.lineno)
+            for kw in n.keywords:
+                lit = _fresh_literal(kw.value) if kw.arg else None
+                if lit and kw.arg in names:
+                    self._add(
+                        "TPS501", mi, fi,
+                        f"{lit} passed as static_argnames {kw.arg!r} of "
+                        f"{base}() (non-hashable / fresh per call)",
+                        n.lineno)
+
+    # TPS504/TPS505 over one HOST-side function.
+    def _check_closure_capture(self, mi: ModuleInfo, fi: FuncInfo) -> None:
+        node = fi.node
+        params = set(_positional_params(node))
+        if not params:
+            return
+        # Locals built per call from params via array constructors.
+        fresh_arrays: dict[str, int] = {}
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1 \
+                    or not isinstance(n.targets[0], ast.Name):
+                continue
+            v = n.value
+            if not isinstance(v, ast.Call):
+                continue
+            name = dotted(v.func) or ""
+            ns, _, last = name.rpartition(".")
+            if last in ARRAY_BUILDERS and ns in ARRAY_NAMESPACES:
+                uses_param = any(
+                    isinstance(sub, ast.Name) and sub.id in params
+                    for a in [*v.args, *[kw.value for kw in v.keywords]]
+                    for sub in ast.walk(a))
+                if uses_param:
+                    fresh_arrays[n.targets[0].id] = n.lineno
+        local_fns = {n.name: n for n in ast.walk(node)
+                     if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                     and n is not node}
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn_arg = None
+            if _is_jit_name(dotted(n.func)) and n.args:
+                fn_arg = n.args[0]
+            elif isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "register_program" and len(n.args) >= 2:
+                fn_arg = n.args[1]
+            if fn_arg is None:
+                continue
+            target = None
+            if isinstance(fn_arg, ast.Lambda):
+                target = fn_arg
+            elif isinstance(fn_arg, ast.Name) and fn_arg.id in local_fns:
+                target = local_fns[fn_arg.id]
+            if target is None:
+                continue
+            free = _free_names(target)
+            label = (getattr(target, "name", None) or "lambda")
+            for name in sorted(free & params):
+                self._add(
+                    "TPS505", mi, fi,
+                    f"traced {label} captures enclosing argument {name!r} "
+                    "by closure — baked as a constant, retraces per "
+                    "distinct value (pass it as a traced argument)",
+                    n.lineno)
+            for name in sorted(free & set(fresh_arrays)):
+                self._add(
+                    "TPS504", mi, fi,
+                    f"traced {label} captures {name!r}, an array built "
+                    "per call from enclosing arguments (line "
+                    f"{fresh_arrays[name]}) — baked as a constant, "
+                    "retraces per call (pass it as a traced argument)",
+                    n.lineno)
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _add(self, rule: str, mi: ModuleInfo, fi: FuncInfo, message: str,
+             line: int) -> None:
+        f = Finding(rule=rule, file=mi.relpath, symbol=fi.qualname,
+                    message=message, line=line)
+        if f not in self.findings:
+            self.findings.append(f)
+
+
+def run_paths(files: list[Path], root: Path) -> list[Finding]:
+    """Parse ``files``, run the TPS5xx rules, and honor inline sanctions."""
+    modules = []
+    sources: dict[str, list[str]] = {}
+    for path in sorted(files):
+        mi = _parse_module(path, root)
+        if mi is not None:
+            modules.append(mi)
+            try:
+                sources[mi.relpath] = path.read_text().splitlines()
+            except OSError:
+                pass
+    findings = TraceAnalyzer(modules).run()
+    return filter_sanctioned(findings, sources)

@@ -29,6 +29,23 @@ witnessed — third-party and stdlib-internal locks are invisible, and a lock
 acquired and released inside one bytecode run of a C extension cannot be
 seen at all. That is the right trade: the serving path's own 15+ locks are
 the ones whose ordering this repo controls. See docs/ANALYSIS.md.
+
+A second, independent witness lives here too: the **retrace witness**
+(``TPUSERVE_RETRACE_WITNESS=1``). The static pass (tracelint, TPS5xx)
+proves trace discipline over what it can see; the residue — a model whose
+bucket set varies per call, a shape leaking into a program identity — only
+shows up as ``runtime_compiles_total`` ticking under load. The server
+declares a *warmup barrier* once startup compilation is done
+(``declare_warmup_complete``); after it, every compile the runtime reports
+through ``note_compile(tag, variant)`` raises **RetraceViolation naming
+the (tag, variant)** unless it happens inside a ``sanctioned_compiles()``
+window (the lifecycle's cold-boot ``ensure_compiled`` is the one such
+window: demand-compiling a cold model is the feature, not a retrace). The
+jax half — arming ``jax_transfer_guard`` at the barrier and the blessed
+``host_fetch`` escape — lives in ``tpuserve.utils.retrace`` so this module
+stays importable on bare Python (the CI lint job). Smokes export the env
+var exactly like ``TPUSERVE_LOCK_WITNESS``, so every drill doubles as a
+retrace-detection pass.
 """
 
 from __future__ import annotations
@@ -409,3 +426,137 @@ def maybe_install(loop: asyncio.AbstractEventLoop | None = None) -> bool:
         install(loop)
         return True
     return False
+
+
+# ---------------------------------------------------------------------------
+# Retrace witness: compile-stability assertions after the warmup barrier.
+# Pure Python (no jax import) — tpuserve.utils.retrace holds the jax half.
+# ---------------------------------------------------------------------------
+
+_RETRACE_ENV = "TPUSERVE_RETRACE_WITNESS"
+_retrace_forced: bool | None = None
+
+
+class RetraceViolation(WitnessViolation):
+    """The runtime compiled a new executable after the warmup barrier —
+    the steady-state compile-delta-0 invariant broke, and the message
+    names the (tag, variant) that minted the compile."""
+
+
+def retrace_enabled() -> bool:
+    """Retrace witness on? Env-driven unless force_retrace()d."""
+    if _retrace_forced is not None:
+        return _retrace_forced
+    return os.environ.get(_RETRACE_ENV, "").strip().lower() in _TRUE
+
+
+def force_retrace(value: bool | None) -> None:
+    """Test hook: override the env check (None restores env behavior)."""
+    global _retrace_forced
+    _retrace_forced = value
+
+
+class _RetraceRegistry:
+    """Per-process compile ledger around one declared warmup barrier.
+
+    Compiles before the barrier are warmup (counted, silent). A
+    ``sanctioned()`` window marks deliberate post-barrier compilation —
+    the lifecycle's cold-boot ``ensure_compiled`` — process-wide on
+    purpose: the compile may run on an executor thread, not the thread
+    that opened the window. Everything else after the barrier raises."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self.barrier: str | None = None  # declaring site, None = not yet
+        self.warmup_compiles = 0
+        self.sanction_depth = 0
+        self.sanctioned_compiles = 0
+        self.violations: list[dict] = []
+
+    def note_compile(self, tag: str, variant: str) -> None:
+        if not retrace_enabled():
+            return
+        stack = _site_stack()
+        with self._mu:
+            if self.barrier is None:
+                self.warmup_compiles += 1
+                return
+            if self.sanction_depth > 0:
+                self.sanctioned_compiles += 1
+                return
+            msg = (f"compile after warmup barrier: tag={tag} "
+                   f"variant={variant} (barrier declared at {self.barrier})")
+            if len(self.violations) < _MAX_VIOLATIONS:
+                self.violations.append(
+                    {"kind": "retrace", "tag": tag, "variant": variant,
+                     "message": msg, "stack": stack})
+        raise RetraceViolation(f"{msg} [at {stack}]")
+
+    def declare_barrier(self) -> None:
+        with self._mu:
+            self.barrier = _site_stack()
+
+    def sanction_enter(self) -> None:
+        with self._mu:
+            self.sanction_depth += 1
+
+    def sanction_exit(self) -> None:
+        with self._mu:
+            self.sanction_depth = max(0, self.sanction_depth - 1)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "enabled": retrace_enabled(),
+                "barrier_declared": self.barrier is not None,
+                "warmup_compiles": self.warmup_compiles,
+                "sanctioned_compiles": self.sanctioned_compiles,
+                "violations": list(self.violations),
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self.barrier = None
+            self.warmup_compiles = 0
+            self.sanction_depth = 0
+            self.sanctioned_compiles = 0
+            self.violations.clear()
+
+
+_RETRACE = _RetraceRegistry()
+
+
+def note_compile(tag: str, variant: str) -> None:
+    """Runtime compile-site hook (``_compile_bucket``/``register_program``
+    call this at every ``runtime_compiles_total`` tick). Raises
+    RetraceViolation after the barrier outside a sanctioned window."""
+    _RETRACE.note_compile(tag, variant)
+
+
+def declare_warmup_complete() -> None:
+    """The server finished startup compilation: from here on, any
+    unsanctioned compile is a retrace violation. Recorded with the
+    declaring site so the violation message can name it."""
+    _RETRACE.declare_barrier()
+
+
+class sanctioned_compiles:
+    """Context manager blessing deliberate post-barrier compilation
+    (cold-boot ``ensure_compiled``). Process-wide while open."""
+
+    def __enter__(self) -> "sanctioned_compiles":
+        _RETRACE.sanction_enter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _RETRACE.sanction_exit()
+
+
+def retrace_snapshot() -> dict:
+    """Barrier/compile-ledger state (the /stats retrace_witness block)."""
+    return _RETRACE.snapshot()
+
+
+def reset_retrace() -> None:
+    """Drop barrier + ledger (each ServerState.build starts fresh)."""
+    _RETRACE.reset()

@@ -53,7 +53,8 @@ from tpuserve.genserve.arena import SlotArena, SlotInfo
 from tpuserve.genserve.model import GenerativeModel
 from tpuserve.genserve.pages import PageLedger
 from tpuserve.hostpipe import StageExecutors
-from tpuserve.obs import PRIORITIES, Metrics
+from tpuserve.obs import GEN_STREAM_REASONS, PRIORITIES, Metrics
+from tpuserve.utils.retrace import allow_transfers, host_fetch
 
 log = logging.getLogger("tpuserve.genserve")
 
@@ -518,6 +519,11 @@ class GenEngine:
 
     # -- stream emission (event loop; ISSUE 17) -------------------------------
     def _count_termination(self, reason: str) -> None:
+        if reason not in GEN_STREAM_REASONS:
+            # Off-vocabulary labels would fragment the metric and dodge
+            # the docs/tests contract (TPS404): fail loudly in dev.
+            raise ValueError(f"unknown stream-termination reason {reason!r} "
+                             f"(add it to obs.GEN_STREAM_REASONS)")
         self.metrics.counter(
             f"gen_stream_terminated_total{{model={self.name},"
             f"reason={reason}}}").inc()
@@ -632,8 +638,11 @@ class GenEngine:
     def _release_slot(self, slot: int) -> SlotInfo:
         """EVERY slot-release path funnels through here so the slot's KV
         pages return to the free list the same instant the slot frees —
-        retire, evict, disconnect, runaway guard, insert failure alike."""
-        if self.pages is not None:
+        retire, evict, disconnect, runaway guard, insert failure alike.
+        ``holds`` guards the page half: a slot can fail admission before
+        its page-acquire lands (arena.release's SlotCorrupted tripwire
+        still catches double-release through this funnel)."""
+        if self.pages is not None and self.pages.holds(slot):
             self.pages.release(slot)
             self._update_kv_gauges()
         return self.arena.release(slot)
@@ -727,7 +736,7 @@ class GenEngine:
         """One compiled iteration over the slot block + the small host
         fetch of the out pytree. Runs on the fetch stage executor."""
         self._state, out = self.runtime.run_program("step", self._state)
-        return jax.tree_util.tree_map(np.asarray, out)
+        return host_fetch(out)
 
     def _insert_sync(self, slot: int, item: Any) -> None:
         self._state = self.runtime.run_program(
@@ -779,8 +788,7 @@ class GenEngine:
                 return
 
     def _extract_sync(self, slot: int) -> Any:
-        return jax.tree_util.tree_map(
-            np.asarray,
+        return host_fetch(
             self.runtime.run_program("extract", self._state, np.int32(slot)))
 
     # -- scheduling passes ----------------------------------------------------
@@ -906,33 +914,34 @@ class GenEngine:
                             enqueued_at=req.enqueued_at, admitted_at=now,
                             ctx=req.ctx, stream=req.stream)
             slot = self.arena.acquire(info)
-            if self.pages is not None:
-                try:
-                    page_list = self.pages.acquire(slot, req.pages_needed)
-                except Exception:
-                    self.arena.release(slot)
-                    raise
-                self._update_kv_gauges()
-                self._observe_pages(req.pages_needed)
-                n_prompt = self.model.prompt_tokens(req.item)
-                info.meta["pages_row"] = self._pages_row(page_list)
-                info.meta["prefill_n"] = n_prompt
-                info.meta["prefill_next"] = 0
-                info.meta["prefill_chunks"] = \
-                    -(-n_prompt // self._prefill_chunk)
-            if self.arena.n_active > self.peak_active:
-                self.peak_active = self.arena.n_active
-            wait_ms = (now - req.enqueued_at) * 1e3
-            trace_id = req.ctx.trace_id if req.ctx is not None else None
-            self._h_queue.observe(wait_ms, trace_id=trace_id)
-            self._h_qwait[req.priority or self._default_priority].observe(
-                wait_ms, trace_id=trace_id)
-            if req.ctx is not None:
-                wall = time.time()
-                req.ctx.span("queue", wall - wait_ms / 1e3, wall,
-                             tid=self.name)
-            t0 = time.perf_counter()
+            t0 = now
             try:
+                # One protecting try covers the whole held window — page
+                # acquire, host bookkeeping, and the compiled insert — so
+                # no exception path can leak the slot or its pages
+                # (TPS601: ledger escape analysis gates on this).
+                if self.pages is not None:
+                    page_list = self.pages.acquire(slot, req.pages_needed)
+                    self._update_kv_gauges()
+                    self._observe_pages(req.pages_needed)
+                    n_prompt = self.model.prompt_tokens(req.item)
+                    info.meta["pages_row"] = self._pages_row(page_list)
+                    info.meta["prefill_n"] = n_prompt
+                    info.meta["prefill_next"] = 0
+                    info.meta["prefill_chunks"] = \
+                        -(-n_prompt // self._prefill_chunk)
+                if self.arena.n_active > self.peak_active:
+                    self.peak_active = self.arena.n_active
+                wait_ms = (now - req.enqueued_at) * 1e3
+                trace_id = req.ctx.trace_id if req.ctx is not None else None
+                self._h_queue.observe(wait_ms, trace_id=trace_id)
+                self._h_qwait[req.priority or self._default_priority].observe(
+                    wait_ms, trace_id=trace_id)
+                if req.ctx is not None:
+                    wall = time.time()
+                    req.ctx.span("queue", wall - wait_ms / 1e3, wall,
+                                 tid=self.name)
+                t0 = time.perf_counter()
                 if self.pages is not None:
                     # Paged fold-in is incremental: the FIRST prompt chunk
                     # lands now, later chunks interleave with decode steps
@@ -1115,14 +1124,15 @@ class GenEngine:
                                    params_override=staged)
         for _ in range(self._max_steps_guard):
             state, out = rt.run_program("step", state, params_override=staged)
-            if bool(np.asarray(out["done"])[0]):
+            with allow_transfers():  # deliberate: canary progress read
+                done = bool(np.asarray(out["done"])[0])
+            if done:
                 break
         else:
             raise ValueError(
                 f"staged canary did not finish a generation within "
                 f"{self._max_steps_guard} iterations")
-        extracted = jax.tree_util.tree_map(
-            np.asarray,
+        extracted = host_fetch(
             rt.run_program("extract", state, np.int32(0),
                            params_override=staged))
         for path, leaf in jax.tree_util.tree_flatten_with_path(extracted)[0]:

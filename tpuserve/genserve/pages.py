@@ -74,6 +74,13 @@ class PageLedger:
     def pages_of(self, slot: int) -> list[int]:
         return list(self._owned.get(slot, ()))
 
+    def holds(self, slot: int) -> bool:
+        """Whether ``slot`` currently owns pages. The engine's release
+        funnel checks this so a slot whose page-acquire itself failed
+        mid-admit can still return to the arena without tripping the
+        PageCorrupted double-release tripwire."""
+        return slot in self._owned
+
     def acquire(self, slot: int, count: int) -> list[int]:
         """Hand ``count`` free pages to ``slot``; raises PageCorrupted if
         the free-list offers a page the ledger says is already owned, or

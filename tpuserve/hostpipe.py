@@ -80,7 +80,7 @@ class SlotPool:
             return None
         return self._free.pop()
 
-    async def acquire(self, timeout: float | None = None) -> int:
+    async def acquire(self, timeout_s: float | None = None) -> int:
         while True:
             if self._closed:
                 raise SlotsClosed("slot pool closed")
@@ -89,7 +89,7 @@ class SlotPool:
             fut = asyncio.get_running_loop().create_future()
             self._waiters.append(fut)
             try:
-                await asyncio.wait_for(fut, timeout)
+                await asyncio.wait_for(fut, timeout_s)
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 if fut in self._waiters:
                     self._waiters.remove(fut)

@@ -318,7 +318,7 @@ class TextGenServing(GenerativeModel):
         # The state pytree's own shape selects the path (a host-side
         # structural check at trace time): a paged engine allocates the
         # kv_page_signature block, a dense one the state_signature block.
-        if "kp" in state:
+        if "kp" in state:  # tps-ok[TPS503]: pytree structure check at trace time
             return self._paged_decode_step(params, state)
         return self._decode_step(params, state)
 
@@ -429,7 +429,7 @@ class TextGenServing(GenerativeModel):
         return new
 
     def _prefill_paged_chunk(self, params, state, slot, item, start, pages,
-                             chunk):
+                             chunk: int):
         """One chunk of an incremental prompt prefill: BIDIRECTIONAL within
         the chunk, causal across chunks (earlier chunks' K/V are final by
         the time later chunks attend through them). Multi-chunk encoding is

@@ -570,6 +570,22 @@ SCHED_SHED_REASONS = ("deadline_unmeetable", "priority_shed",
 TENANT_SHED_REASONS = ("tenant_unknown", "tenant_rate_exceeded",
                        "tenant_quota_exceeded", "tenant_share_exceeded")
 
+# Reasons on gen_stream_terminated_total{model=,reason=} — how a
+# generation stream ended (tpuserve.genserve.engine._terminate_stream):
+# "done" is the only success; everything else names which machinery cut
+# the stream. The engine guards emission against this tuple so a new
+# call site cannot mint an off-vocabulary label (TPS404 holds each value
+# to a docs/REFERENCE.md row and at least one test).
+GEN_STREAM_REASONS = ("done", "disconnect", "deadline_exceeded",
+                      "engine_error", "drain", "shutdown")
+
+# Reasons on router_stream_terminated_total{model=,reason=} — the
+# worker-router's stream proxy (tpuserve.workerproc.router): same
+# contract as GEN_STREAM_REASONS, seen from the proxy side ("done" the
+# only success; "upstream_error" folds any worker-side failure).
+ROUTER_STREAM_REASONS = ("done", "client_disconnect", "deadline_exceeded",
+                         "idle_timeout", "upstream_error", "drain")
+
 
 class Metrics:
     """Registry of all server metrics. One instance per server process."""

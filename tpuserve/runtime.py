@@ -39,9 +39,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpuserve.analysis import witness
 from tpuserve.config import ModelConfig, ParallelConfig, ServerConfig
 from tpuserve.models.base import ServingModel
 from tpuserve.obs import Metrics
+from tpuserve.utils.retrace import allow_transfers, host_fetch
 from tpuserve.parallel import make_mesh, match_partition_rules
 from tpuserve.parallel.mesh import MeshPlan, plan_for, select_devices
 from tpuserve.parallel.partition import specs_to_shardings
@@ -347,7 +349,8 @@ class ModelRuntime:
                 params = self.model.load_params()
         else:
             params = self.model.load_params()
-        params = jax.device_get(params)
+        with allow_transfers():  # deliberate: weights land host-side first
+            params = jax.device_get(params)
         # Integrity gate BEFORE the compute-dtype cast: the sidecar manifest
         # digests the checkpoint's raw bytes, so the comparison must see the
         # tree exactly as restored.
@@ -558,6 +561,11 @@ class ModelRuntime:
         # Registered before the counters tick so a scrape can never observe
         # a compile with no variant behind it.
         self._c_compiles.inc(len(exes))
+        # Retrace witness: a post-warmup-barrier compile raises here, with
+        # the variant already registered and the counter ticked — the
+        # ledgers stay consistent while the violation propagates to
+        # whoever demanded the compile.
+        witness.note_compile(self.model.name, key.label)
         self._g_variants.set(len(self.variants))
         self._c_variant_batches[bucket] = self.metrics.counter(
             f"runtime_variant_batches_total{{model={self.model.name},"
@@ -624,6 +632,7 @@ class ModelRuntime:
                              donated=bool(donate))],
             compile_ms=(time.perf_counter() - t0) * 1e3)
         self._c_compiles.inc()
+        witness.note_compile(tag, key.label)  # retrace witness (see above)
         self._g_variants.set(len(self.variants))
         prog.counter = self._c_variant_batches[(tag, width)] = \
             self.metrics.counter(
@@ -745,8 +754,10 @@ class ModelRuntime:
 
     @staticmethod
     def fetch(outputs: Any) -> Any:
-        """Block for D2H; call off the event loop."""
-        return jax.tree_util.tree_map(np.asarray, outputs)
+        """Block for D2H; call off the event loop. Routes through the
+        retrace witness's blessed readback so an armed transfer guard
+        (TPUSERVE_RETRACE_WITNESS=1) never trips on deliberate fetches."""
+        return host_fetch(outputs)
 
     def prewarm(self) -> None:
         """Execute every (bucket, replica) once on zeros and block for it.

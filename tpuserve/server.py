@@ -301,6 +301,10 @@ class ServerState:
         self.serving_addresses: list = []
 
     def build(self) -> None:
+        # Retrace witness (docs/ANALYSIS.md): every build starts a fresh
+        # warmup window; the barrier is declared once startup compilation
+        # below is done, after which an unsanctioned compile raises.
+        witness.reset_retrace()
         configure_jax(self.cfg)
         if self.cfg.profiler_port:
             jax.profiler.start_server(self.cfg.profiler_port)
@@ -389,6 +393,17 @@ class ServerState:
                 log.info("model %s ready in %.1fs: %s", mcfg.name, time.perf_counter() - t0, rt.describe())
         finally:
             compile_pool.shutdown()
+        # Startup compilation done: from here on the steady-state
+        # compile-delta-0 invariant is LIVE. Under
+        # TPUSERVE_RETRACE_WITNESS=1 any further unsanctioned compile
+        # raises RetraceViolation naming its (tag, variant), and implicit
+        # device->host transfers are disallowed (utils.retrace).
+        witness.declare_warmup_complete()
+        if witness.retrace_enabled():
+            from tpuserve.utils.retrace import arm_transfer_guard
+
+            arm_transfer_guard()
+            log.info("retrace witness armed (TPUSERVE_RETRACE_WITNESS)")
 
     def ingest_handles(self, index: int) -> IngestHandles:
         """Prebound ingest counters for accept loop ``index`` (idempotent)."""
@@ -600,7 +615,7 @@ class ServerState:
             for name, m in self.models.items()
         }
 
-    async def run_canary(self, name: str, timeout: float = 60.0) -> bool:
+    async def run_canary(self, name: str, timeout_s: float = 60.0) -> bool:
         """Tiny end-to-end inference for one model; feeds /healthz and
         half-opens/closes the circuit breaker (canaries ride the batcher
         regardless of breaker state — they ARE the recovery probe)."""
@@ -618,7 +633,7 @@ class ServerState:
                 br.probe()
             item = model.canary_item()
             fut = self.batchers[name].submit(item, group=model.group_key(item))
-            await asyncio.wait_for(fut, timeout=timeout)
+            await asyncio.wait_for(fut, timeout=timeout_s)
             self.canary_ok[name] = True
         except QueueFull:
             # A full queue is load shedding doing its job, not ill health;
@@ -632,11 +647,11 @@ class ServerState:
         # must not KeyError — treat never-measured as healthy.
         return self.canary_ok.get(name, True)
 
-    async def run_canaries(self, timeout: float = 60.0,
+    async def run_canaries(self, timeout_s: float = 60.0,
                            timeouts: dict[str, float] | None = None) -> None:
         # Concurrent: one hung model must not stall (or stale) the others.
         await asyncio.gather(
-            *(self.run_canary(name, timeout=(timeouts or {}).get(name, timeout))
+            *(self.run_canary(name, timeout_s=(timeouts or {}).get(name, timeout_s))
               for name in self.models))
 
     # -- graceful drain ------------------------------------------------------
@@ -1602,6 +1617,9 @@ async def handle_stats(request: web.Request) -> web.Response:
     if witness.enabled():
         # Observed lock-order graph + any violations (docs/ANALYSIS.md).
         out["robustness"]["lock_witness"] = witness.snapshot()
+    if witness.retrace_enabled():
+        # Warmup barrier + post-barrier compile ledger (docs/ANALYSIS.md).
+        out["robustness"]["retrace_witness"] = witness.retrace_snapshot()
     # Versioned lifecycle state: what version is live per model, what is
     # retained for rollback, and the recent transition history.
     if state.lifecycles:

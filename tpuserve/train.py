@@ -195,7 +195,7 @@ def make_train_step(model, tx, mesh: Mesh, param_shardings):
     # differs from the input under sp/tp meshes (XlaRuntimeError INTERNAL
     # "aliased input ... to have the same size"), so skip it there.
     donate = (0, 1) if hasattr(jax, "typeof") else ()
-    return jax.jit(
+    return jax.jit(  # tps-ok[TPS501,TPS505]: setup-time factory, jitted once per run
         step,
         in_shardings=(param_shardings, None, batch_sharding),
         out_shardings=(param_shardings, None, None),

@@ -196,7 +196,7 @@ def make_det_train_step(serving, tx, mesh: Mesh, tcfg: DetTrainConfig):
         new_params["params"] = optax.apply_updates(params["params"], updates)
         return new_params, opt_state, loss
 
-    return jax.jit(
+    return jax.jit(  # tps-ok[TPS501,TPS505]: setup-time factory, jitted once per run
         step,
         in_shardings=(replicated, None, batch_sharding),
         out_shardings=(replicated, None, None),

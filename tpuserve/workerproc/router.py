@@ -62,8 +62,9 @@ from tpuserve.analysis import witness
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig, SloConfig
 from tpuserve.faults import CircuitBreaker, Watchdog
-from tpuserve.obs import (FlightRecorder, Metrics, TraceContext,
-                          exposition_content_type, spans_to_chrome)
+from tpuserve.obs import (ROUTER_STREAM_REASONS, FlightRecorder, Metrics,
+                          TraceContext, exposition_content_type,
+                          spans_to_chrome)
 from tpuserve.scheduler.autopilot import (Action, AutopilotLoop,
                                           DomainSignal, ModelSignal, Signals)
 from tpuserve.scheduler.tenants import TenantLedger
@@ -895,7 +896,12 @@ class RouterState:
     def _count_stream_termination(self, name: str, reason: str) -> None:
         """Tick router_stream_terminated_total{model=,reason=}. Created
         on demand per reason — Metrics.counter dedups by full name, so
-        the handle is stable after the first tick."""
+        the handle is stable after the first tick. Emission is guarded
+        against the closed vocabulary (TPS404): an off-list reason would
+        fragment the metric and dodge the docs/tests contract."""
+        if reason not in ROUTER_STREAM_REASONS:
+            raise ValueError(f"unknown stream-termination reason {reason!r} "
+                             f"(add it to obs.ROUTER_STREAM_REASONS)")
         self.metrics.counter(
             "router_stream_terminated_total"
             f"{{model={name},reason={reason}}}").inc()
