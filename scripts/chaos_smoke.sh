@@ -38,6 +38,9 @@ scripts/genserve_smoke.sh
 echo "== pagedkv smoke (slot-count win at fixed KV memory, flat gap p99 under chunked prefill, compile delta 0) =="
 scripts/pagedkv_smoke.sh
 
+echo "== meshgen smoke (replica group balanced, sharded==single token parity, reload mid-load, compile delta 0) =="
+scripts/meshgen_smoke.sh
+
 echo "== ingest smoke (framed wire, 3 accept loops balanced, compile delta 0) =="
 scripts/ingest_smoke.sh
 

@@ -1,0 +1,206 @@
+#!/usr/bin/env bash
+# Generation-on-the-mesh smoke (ISSUE 20): the replica-per-chip engine
+# group and the tensor-parallel decode leg through the REAL server on the
+# CPU backend (8 forced host devices standing in for chips):
+#   1. replica leg: mixed-length load over a 4-replica engine group with a
+#      mid-load :reload (staged canary fanned to EVERY replica) — zero
+#      errors, runtime_compiles_total delta exactly 0, and every replica's
+#      /stats per_replica row shows nonzero steps (least-loaded placement
+#      keeps all chips generating; a flat-zero row is a starved chip);
+#   2. sharded leg: the SAME prompts/seeds/temperatures through a
+#      parallelism='sharded' tp=2 server and a single-mesh server must
+#      produce byte-identical tokens (greedy AND sampled — the
+#      jax_threefry_partitionable seam), with a mid-load :reload on the
+#      sharded leg also at compile delta 0;
+#   3. both legs run under the lock witness AND the retrace witness: a
+#      post-warmup compile or unblessed device->host fetch raises
+#      mid-load rather than slipping into the numbers.
+# Honest label: CPU backend, forced host devices — this gates PLACEMENT,
+# PARITY, and the zero-recompile obligation, not chip throughput.
+# Run by CI next to the genserve/paged-KV smokes; see docs/PERFORMANCE.md
+# "Generation on the mesh".
+set -euo pipefail
+cd "$(dirname "$0")/.." || exit 1
+export JAX_PLATFORMS=cpu
+export TPUSERVE_LOCK_WITNESS=1
+export TPUSERVE_RETRACE_WITNESS=1
+# 8 fake chips; keep any other XLA_FLAGS the environment set.
+case "${XLA_FLAGS:-}" in
+  *xla_force_host_platform_device_count*) ;;
+  *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+esac
+
+python - <<'EOF'
+import asyncio
+import json
+
+import aiohttp
+from aiohttp import web
+
+from tpuserve.bench.loadgen import run_load, synthetic_prompt_pool
+from tpuserve.config import (GenserveConfig, ModelConfig, ParallelConfig,
+                             ServerConfig)
+from tpuserve.server import ServerState, make_app
+
+TG_OPTS = dict(layers=1, d_model=64, heads=2, d_ff=128, vocab_size=512,
+               prompt_len=16, max_new_tokens=32)
+
+# Mixed greedy + sampled lanes: the sampled ones cross the sharded gumbel
+# draw, the seam jax_threefry_partitionable exists for.
+PARITY_REQS = [
+    {"prompt": "hello mesh", "seed": 0, "max_new_tokens": 8},
+    {"prompt": "the quick brown fox jumps over the lazy dog", "seed": 7,
+     "max_new_tokens": 12, "temperature": 0.8},
+    {"prompt": "one two three four five six seven", "seed": 3,
+     "max_new_tokens": 10, "temperature": 0.4},
+]
+
+
+def server_cfg(parallelism: str, n_chips: int, **model_over) -> ServerConfig:
+    return ServerConfig(
+        decode_threads=2,
+        startup_canary=False,
+        genserve=GenserveConfig(enabled=True, slots=2, kv_paging=True,
+                                kv_page_tokens=8),
+        parallel=ParallelConfig(mode=parallelism, n_chips=n_chips),
+        models=[ModelConfig(
+            name="textgen", family="textgen", batch_buckets=[1, 2, 4],
+            dtype="float32", parallelism="single",
+            request_timeout_ms=60_000.0, options=dict(TG_OPTS),
+            **model_over)])
+
+
+async def scrape(base, session):
+    async with session.get(f"{base}/metrics") as r:
+        text = await r.text()
+    metrics = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        k, v = line.rsplit(" ", 1)
+        try:
+            metrics[k] = float(v)
+        except ValueError:
+            pass
+    async with session.get(f"{base}/stats") as r:
+        stats = await r.json()
+    return metrics, stats
+
+
+class Leg:
+    def __init__(self, cfg):
+        self.state = ServerState(cfg)
+
+    async def __aenter__(self):
+        self.state.build()
+        self.runner = web.AppRunner(make_app(self.state), access_log=None)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        return f"http://127.0.0.1:{self.runner.addresses[0][1]}"
+
+    async def __aexit__(self, *exc):
+        await self.runner.cleanup()
+
+
+async def generate_all(base, session):
+    toks = []
+    for req in PARITY_REQS:
+        async with session.post(
+                f"{base}/v1/models/textgen:generate", data=json.dumps(req),
+                headers={"Content-Type": "application/json"}) as r:
+            body = await r.json()
+            assert r.status == 200, body
+            toks.append(body["tokens"])
+    return toks
+
+
+async def reload_ok(base, session):
+    async with session.post(f"{base}/admin/models/textgen:reload") as r:
+        body = await r.json()
+        assert r.status == 200 and body["canary_ok"] is True, body
+
+
+async def replica_leg():
+    """4-replica engine group: balance + compile delta 0 across a reload."""
+    async with Leg(server_cfg("replica", 4)) as base:
+        pool = synthetic_prompt_pool(32, max_new=(2, 32))
+        url = f"{base}/v1/models/textgen:generate"
+        res = await run_load(url, pool, "application/json",
+                             duration_s=2.0, warmup_s=0.5, concurrency=8)
+        assert res.n_err == 0 and res.n_ok > 0, res.summary()
+        async with aiohttp.ClientSession() as s:
+            m0, _ = await scrape(base, s)
+            res2 = await run_load(url, pool, "application/json",
+                                  duration_s=1.5, warmup_s=0.0,
+                                  concurrency=8)
+            assert res2.n_err == 0, res2.summary()
+            # Mid-load reload: the staged canary runs a short REAL
+            # generation on EVERY replica, then publish — no compiles.
+            await reload_ok(base, s)
+            res3 = await run_load(url, pool, "application/json",
+                                  duration_s=1.0, warmup_s=0.0,
+                                  concurrency=8)
+            assert res3.n_err == 0, res3.summary()
+            m1, stats = await scrape(base, s)
+
+        key = 'runtime_compiles_total{model="textgen"}'
+        delta = m1.get(key, 0) - m0.get(key, 0)
+        assert delta == 0, f"replica leg recompiled: delta={delta}"
+        gs = stats["genserve"]["textgen"]
+        assert gs["replicas"] == 4 and gs["slots"] == 8, gs
+        assert gs["active"] == 0 and gs["free"] == 8, gs  # ledger balanced
+        rows = gs["per_replica"]
+        assert [r["replica"] for r in rows] == [0, 1, 2, 3], rows
+        steps = [r["steps_total"] for r in rows]
+        assert all(s > 0 for s in steps), f"starved replica: {steps}"
+        for r in rows:  # every page pool came home
+            assert r["kv"]["free"] == r["kv"]["usable"], rows
+        for i in range(4):
+            k = f'gen_replica_steps_total{{model="textgen",replica="{i}"}}'
+            assert m1.get(k, 0) > 0, f"missing metric row {k}"
+        rw = stats["robustness"]["retrace_witness"]
+        assert rw["enabled"] and rw["barrier_declared"], rw
+        assert rw["violations"] == [], rw
+        return res2.throughput, steps, m1[key]
+
+
+async def sharded_leg():
+    """tp=2 sharded decode: token parity vs the single mesh + delta 0."""
+    async with Leg(server_cfg("single", 1)) as base:
+        async with aiohttp.ClientSession() as s:
+            single_toks = await generate_all(base, s)
+    async with Leg(server_cfg("sharded", 4, tp=2)) as base:
+        async with aiohttp.ClientSession() as s:
+            m0, _ = await scrape(base, s)
+            sharded_toks = await generate_all(base, s)
+            await reload_ok(base, s)
+            again = await generate_all(base, s)
+            m1, stats = await scrape(base, s)
+        key = 'runtime_compiles_total{model="textgen"}'
+        delta = m1.get(key, 0) - m0.get(key, 0)
+        assert delta == 0, f"sharded leg recompiled: delta={delta}"
+        assert sharded_toks == single_toks, (
+            f"sharded decode diverged from single mesh:\n"
+            f"  single:  {single_toks}\n  sharded: {sharded_toks}")
+        assert again == single_toks, "parity broke across the reload"
+        sig = stats["parallel"]["textgen"]["signature"]
+        assert sig == "sharded@d2", sig
+        rw = stats["robustness"]["retrace_witness"]
+        assert rw["enabled"] and rw["violations"] == [], rw
+    return sig
+
+
+async def main():
+    tput, steps, compiles = await replica_leg()
+    sig = await sharded_leg()
+    print(f"meshgen smoke OK: replica leg {tput:.1f} req/s, "
+          f"per-replica steps {steps} (all nonzero), compile delta 0 "
+          f"(total {compiles:.0f}); sharded leg {sig} token-identical to "
+          f"single mesh across a mid-load reload, compile delta 0; "
+          f"lock + retrace witnesses clean [cpu backend, 8 forced host "
+          f"devices]")
+
+
+asyncio.run(main())
+EOF

@@ -1,0 +1,530 @@
+"""Generation on the mesh (ISSUE 20): sharded==single token parity over
+mixed lengths/seeds/temps with paged KV on, balanced replica-per-chip
+placement under sustained mixed load, zero recompiles across churn AND
+publish/rollback on both legs, the group's fanned staged canary, the MoE
+textgen variant, and the fleet scheduler's chip-budget placement by
+parallelism degree. docs/PERFORMANCE.md "Generation on the mesh"."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from tpuserve.config import (GenserveConfig, ModelConfig, ParallelConfig,
+                             SchedulerConfig, ServerConfig)
+from tpuserve.genserve import GenEngine, GenEngineGroup
+from tpuserve.models import build
+from tpuserve.obs import SCHED_SHED_REASONS, Metrics
+from tpuserve.runtime import build_runtime
+from tpuserve.scheduler import FleetScheduler
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnraisableExceptionWarning")
+
+TG_OPTS = dict(layers=1, d_model=32, heads=2, d_ff=64, vocab_size=512,
+               prompt_len=16, max_new_tokens=64)
+
+# Mixed lengths / seeds / max_new / temperatures — greedy and sampled lanes
+# both cross the sharded gumbel draw (the jax_threefry_partitionable seam).
+PROMPTS = [
+    ("a", 1, 3, 0.0),
+    ("the quick brown fox jumps over the lazy dog again and again", 2,
+     12, 0.7),
+    ("short prompt", 3, 1, 0.0),
+    ("one two three four five six seven eight nine ten eleven twelve "
+     "thirteen fourteen fifteen sixteen", 4, 8, 0.3),
+    ("hello", 5, 20, 1.0),
+    ("mid size prompt with a few words", 6, 5, 0.0),
+]
+
+
+def tg_cfg(**over) -> ModelConfig:
+    base = dict(name="tg", family="textgen", batch_buckets=[1, 2, 4],
+                dtype="float32", parallelism="single", max_queue=64,
+                request_timeout_ms=60_000.0, options=dict(TG_OPTS))
+    base.update(over)
+    return ModelConfig(**base)
+
+
+def paged() -> GenserveConfig:
+    return GenserveConfig(slots=4, kv_paging=True, kv_page_tokens=8)
+
+
+@pytest.fixture(scope="module")
+def single_rt():
+    """Single-mesh paged baseline — the parity reference."""
+    model = build(tg_cfg())
+    rt = build_runtime(model, compile_forward=False)
+    GenEngine(model, rt, Metrics(), paged()).compile()
+    return model, rt
+
+
+@pytest.fixture(scope="module")
+def sharded_rt():
+    """Tensor-parallel decode: tp=2 over 4 of the conftest's 8 forced host
+    devices (data=2 x model=2 mesh). Same deterministic params as
+    single_rt — build() seeds from the model config, not the mesh."""
+    model = build(tg_cfg(parallelism="sharded", tp=2))
+    rt = build_runtime(model, compile_forward=False,
+                       parallel=ParallelConfig(n_chips=4))
+    GenEngine(model, rt, Metrics(), paged()).compile()
+    return model, rt
+
+
+@pytest.fixture(scope="module")
+def replica_rt():
+    """Replica-per-chip runtime: 4 independent 1-device meshes."""
+    model = build(tg_cfg(parallelism="replica"))
+    rt = build_runtime(model, compile_forward=False,
+                       parallel=ParallelConfig(n_chips=4))
+    met = Metrics()
+    GenEngineGroup(model, rt, met, paged()).compile()
+    return model, rt, met
+
+
+def prompt_item(model, prompt="hello world", seed=0, max_new=8, temp=0.0):
+    body = {"prompt": prompt, "seed": seed, "max_new_tokens": max_new}
+    if temp:
+        body["temperature"] = temp
+    return model.host_decode(json.dumps(body).encode(), "application/json")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def drive(eng, model, prompts):
+    await eng.start()
+    futs = [eng.submit(prompt_item(model, p, seed=s, max_new=n, temp=t))
+            for (p, s, n, t) in prompts]
+    res = await asyncio.gather(*futs)
+    await eng.stop()
+    return [r["tokens"] for r in res]
+
+
+# ---------------------------------------------------------------------------
+# Sharded decode: token parity and the zero-recompile obligation
+# ---------------------------------------------------------------------------
+
+def test_sharded_signature_and_geometry(sharded_rt, replica_rt):
+    _, sh = sharded_rt
+    _, rp, _ = replica_rt
+    assert sh.parallel_signature == "sharded@d2"
+    assert sh.n_chips == 4 and sh.n_replicas == 1
+    assert rp.parallel_signature == "replica@4"
+    assert rp.n_chips == 4 and rp.n_replicas == 4
+
+
+def test_sharded_matches_single_token_identical(single_rt, sharded_rt):
+    """Tensor-parallel decode must be byte-identical to the single mesh at
+    the same seed/temperature with paged KV on — head-sharded attention
+    changes the reduction LAYOUT, never the reduction, and
+    jax_threefry_partitionable makes the sampled lanes sharding-invariant
+    too (the gumbel draw over vocab-sharded logits draws the SAME bits it
+    would on one device)."""
+    s_model, _ = single_rt
+    sh_model, _ = sharded_rt
+    s_eng, _ = _make_engine(single_rt)
+    sh_eng, _ = _make_engine(sharded_rt)
+    base = run(drive(s_eng, s_model, PROMPTS))
+    mesh = run(drive(sh_eng, sh_model, PROMPTS))
+    assert base == mesh, (base, mesh)
+    # Both page ledgers balanced after the drain.
+    assert sh_eng.pages.n_free == sh_eng.pages.usable
+    assert sh_eng.pages.n_reserved == 0
+
+
+def _make_engine(fix, metrics=None):
+    model, rt = fix[0], fix[1]
+    m = metrics or Metrics()
+    eng = GenEngine(model, rt, m, paged())
+    eng.compile()  # reuses the runtime's registered programs
+    return eng, m
+
+
+def test_sharded_zero_recompiles_across_churn_and_reload(sharded_rt):
+    """Slot churn + page churn + a publish AND a rollback mid-churn with
+    runtime_compiles_total delta exactly 0 on the sharded leg: page rows,
+    block tables, and slot indices are traced arguments of the ONE
+    per-mesh step executable."""
+    model, rt = sharded_rt
+    eng, _ = _make_engine(sharded_rt)
+    c0 = rt.compiles_total
+
+    async def churn():
+        await eng.start()
+        futs = [eng.submit(prompt_item(model, f"wave one {i}", seed=i,
+                                       max_new=4 + i % 5))
+                for i in range(8)]
+        await asyncio.gather(*futs)
+        rt.publish(rt.stage_params())
+        futs = [eng.submit(prompt_item(model, f"wave two {i}", seed=10 + i,
+                                       max_new=3 + i % 7, temp=0.5))
+                for i in range(8)]
+        await asyncio.gather(*futs)
+        rt.rollback()
+        futs = [eng.submit(prompt_item(model, f"wave three {i}", seed=20 + i,
+                                       max_new=6))
+                for i in range(4)]
+        await asyncio.gather(*futs)
+        await eng.stop()
+
+    run(churn())
+    assert rt.compiles_total == c0
+    assert eng.arena.n_free == eng.slots
+    assert eng.pages.n_free == eng.pages.usable
+
+
+# ---------------------------------------------------------------------------
+# Replica-per-chip group: balance, parity, canary fan-out, zero recompiles
+# ---------------------------------------------------------------------------
+
+def test_replica_group_balanced_under_sustained_mixed_load(replica_rt):
+    """Least-loaded placement keeps every chip generating: under a
+    sustained mixed-length load every replica's steps/units counters are
+    nonzero — both on the /stats per_replica rows and on the
+    gen_replica_*_total metric rows the placement-balance alert reads."""
+    model, rt, met = replica_rt
+    grp = GenEngineGroup(model, rt, met, paged())
+    grp.compile()
+    assert len(grp.engines) == 4 and grp.slots == 16
+    c0 = rt.compiles_total
+
+    async def load():
+        await grp.start()
+        futs = [grp.submit(prompt_item(model, f"prompt number {i}",
+                                       seed=i, max_new=4 + i % 9,
+                                       temp=(0.0, 0.4, 0.9)[i % 3]))
+                for i in range(24)]
+        res = await asyncio.gather(*futs)
+        ok = await grp.drain(asyncio.get_running_loop().time() + 10)
+        await grp.stop()
+        return res, ok
+
+    res, ok = run(load())
+    assert ok and len(res) == 24
+    stats = grp.pipeline_stats()
+    rows = stats["per_replica"]
+    assert [r["replica"] for r in rows] == [0, 1, 2, 3]
+    assert all(r["steps_total"] > 0 for r in rows), rows
+    assert all(r["units_total"] > 0 for r in rows), rows
+    assert all(r["kv"]["free"] == r["kv"]["usable"] for r in rows), rows
+    # The metric rows are the same truth (prebound singletons).
+    for i in range(4):
+        assert met.counter(
+            f"gen_replica_steps_total{{model=tg,replica={i}}}").value > 0
+        assert met.counter(
+            f"gen_replica_units_total{{model=tg,replica={i}}}").value > 0
+    # Units conserve: per-replica rows decompose the model-level total.
+    assert sum(r["units_total"] for r in rows) == sum(
+        met.counter(
+            f"gen_replica_units_total{{model=tg,replica={i}}}").value
+        for i in range(4))
+    # Zero recompiles across the whole run — the group reused the
+    # registered per-mesh executables.
+    assert rt.compiles_total == c0
+
+
+def test_replica_group_parity_and_canary_fanout(single_rt, replica_rt):
+    """A replica engine runs the SAME single-mesh program — tokens match
+    the single baseline exactly; the group's staged canary fans to every
+    replica and a failure names the replica that rejected."""
+    s_model, _ = single_rt
+    model, rt, met = replica_rt
+    grp = GenEngineGroup(model, rt, met, paged())
+    grp.compile()
+    s_eng, _ = _make_engine(single_rt)
+    sub = PROMPTS[:3]
+    base = run(drive(s_eng, s_model, sub))
+    mesh = run(drive(grp, model, sub))
+    assert base == mesh, (base, mesh)
+
+    # Canary fan-out: a clean staged tree passes on all four replicas with
+    # zero recompiles (params_override is a traced donor, not a geometry).
+    c0 = rt.compiles_total
+    grp2 = GenEngineGroup(model, rt, met, paged())
+    grp2.compile()
+    grp2.staged_canary_sync(rt.stage_params())
+    assert rt.compiles_total == c0
+    # A broken candidate (wrong tree structure — a truncated checkpoint)
+    # rejects and the error names the replica that refused it.
+    with pytest.raises(ValueError, match=r"staged canary failed on "
+                                         r"replica 0"):
+        grp2.staged_canary_sync({"not": "a-param-tree"})
+
+
+def test_replica_group_zero_recompiles_across_reload(replica_rt):
+    """publish + rollback mid-load on the group: compiles delta exactly 0
+    — every replica flips the same versioned param slot."""
+    model, rt, met = replica_rt
+    grp = GenEngineGroup(model, rt, met, paged())
+    grp.compile()
+    c0 = rt.compiles_total
+
+    async def churn():
+        await grp.start()
+        futs = [grp.submit(prompt_item(model, f"pre {i}", seed=i, max_new=5))
+                for i in range(8)]
+        await asyncio.gather(*futs)
+        rt.publish(rt.stage_params())
+        futs = [grp.submit(prompt_item(model, f"post {i}", seed=i,
+                                       max_new=5, temp=0.6))
+                for i in range(8)]
+        await asyncio.gather(*futs)
+        rt.rollback()
+        futs = [grp.submit(prompt_item(model, f"back {i}", seed=i, max_new=4))
+                for i in range(4)]
+        await asyncio.gather(*futs)
+        await grp.stop()
+
+    run(churn())
+    assert rt.compiles_total == c0
+
+
+# ---------------------------------------------------------------------------
+# MoE textgen variant
+# ---------------------------------------------------------------------------
+
+def test_moe_textgen_engine_decode():
+    """options.moe_experts swaps the dense MLP for a top-1 Switch FFN over
+    ops.moe.switch_route — same engine, same programs, deterministic."""
+    model = build(tg_cfg(options=dict(TG_OPTS, moe_experts=4)))
+    rt = build_runtime(model, compile_forward=False)
+    eng = GenEngine(model, rt, Metrics(), paged())
+    eng.compile()
+    toks = run(drive(eng, model, PROMPTS[:2]))
+    assert all(len(t) > 0 for t in toks)
+    again = GenEngine(model, rt, Metrics(), paged())
+    again.compile()
+    assert toks == run(drive(again, model, PROMPTS[:2]))
+
+
+def test_moe_experts_validation():
+    for bad in (1, -2):
+        with pytest.raises(ValueError, match="moe_experts"):
+            build(tg_cfg(options=dict(TG_OPTS, moe_experts=bad)))
+
+
+# ---------------------------------------------------------------------------
+# Fleet scheduler: chip-budget placement by parallelism degree
+# ---------------------------------------------------------------------------
+
+class FakeRuntime:
+    def __init__(self, n_chips=1, signature="single"):
+        self.n_chips = n_chips
+        self.parallel_signature = signature
+        self.released = 0
+
+    def release_params(self):
+        self.released += 1
+
+
+class StubBatcher:
+    def __init__(self, pending=0):
+        self.pending = pending
+        self.device_time_cb = None
+
+    def estimate_clear_s(self):
+        return None
+
+    def predicted_service_s(self, n_items=1):
+        return None
+
+
+def model_cfg(name, **over):
+    base = dict(family="toy", batch_buckets=[1], deadline_ms=5.0,
+                dtype="float32", num_classes=10, parallelism="single",
+                request_timeout_ms=10_000.0, wire_size=8)
+    base.update(over)
+    return ModelConfig(name=name, **base)
+
+
+def make_sched(**cfg_over) -> FleetScheduler:
+    base = dict(enabled=True)
+    base.update(cfg_over)
+    return FleetScheduler(SchedulerConfig(**base), Metrics())
+
+
+async def noop_warm():
+    return {"version": 1}
+
+
+def test_chip_budget_config_validation():
+    with pytest.raises(ValueError):
+        SchedulerConfig(chip_budget=-1)
+    assert "chip_budget" in SCHED_SHED_REASONS
+
+
+def test_chip_budget_degrees_and_stats():
+    """Placement is by parallelism DEGREE: a replica@4 group claims 4
+    chips, a single-chip model 1, and /stats surfaces both the per-model
+    parallel block and the fleet occupancy."""
+    sched = make_sched(chip_budget=8)
+    sched.register("wide", StubBatcher(),
+                   model_cfg("wide", parallelism="replica"),
+                   runtime=FakeRuntime(4, "replica@4"))
+    sched.register("narrow", StubBatcher(), model_cfg("narrow"),
+                   runtime=FakeRuntime(1, "single"))
+    sched.register("bare", StubBatcher(), model_cfg("bare"))  # no runtime
+    assert sched.chips_in_use() == 6
+    s = sched.stats()
+    assert s["chip_budget"] == 8 and s["chips_in_use"] == 6
+    assert s["models"]["wide"]["parallel"] == {
+        "signature": "replica@4", "degree": 4}
+    assert s["models"]["bare"]["parallel"] == {
+        "signature": "single", "degree": 1}
+
+
+def test_chip_budget_sheds_cold_model_that_cannot_fit(loop):
+    """A cold model whose degree overflows the budget sheds 503
+    chip_budget at admission (warm residents are not victims unless they
+    are idle cold_start models), and the :warm endpoint refuses with the
+    same accounting."""
+    async def go():
+        sched = make_sched(chip_budget=4)
+        sched.register("resident", StubBatcher(),
+                       model_cfg("resident", parallelism="replica"),
+                       runtime=FakeRuntime(4, "replica@4"))
+        sched.register("cold2", StubBatcher(),
+                       model_cfg("cold2", cold_start=True),
+                       runtime=FakeRuntime(2, "sharded@d1"),
+                       warm_fn=noop_warm, cold=True)
+        shed = sched.check_admission("cold2", "interactive")
+        assert shed is not None and shed.status == 503
+        assert shed.reason == "chip_budget"
+        assert "needs 2 chip(s)" in shed.message
+        assert sched.state_of("cold2") == "cold"  # warm-up never kicked
+        assert sched._entries["cold2"].shed_counters[
+            "chip_budget"].value == 1
+        with pytest.raises(ValueError, match="chip budget"):
+            await sched.warm("cold2")
+
+    loop.run_until_complete(go())
+
+
+def test_chip_budget_demotes_idle_cold_start_to_make_room(loop):
+    """An idle warm cold_start model is demoted (largest degree first) to
+    make room for an incoming cold model — placement prefers serving the
+    model with demand over holding idle params resident."""
+    async def go():
+        sched = make_sched(chip_budget=4)
+        idle_rt = FakeRuntime(4, "replica@4")
+        sched.register("idle", StubBatcher(pending=0),
+                       model_cfg("idle", parallelism="replica",
+                                 cold_start=True),
+                       runtime=idle_rt)
+        sched.register("cold2", StubBatcher(),
+                       model_cfg("cold2", cold_start=True),
+                       runtime=FakeRuntime(2, "sharded@d1"),
+                       warm_fn=noop_warm, cold=True)
+        assert sched.chips_in_use() == 4
+        shed = sched.check_admission("cold2", "interactive")
+        # The victim was demoted and the warm-up kicked: the caller sees
+        # the ordinary model_warming shed, not chip_budget.
+        assert shed is not None and shed.reason == "model_warming"
+        assert sched.state_of("idle") == "cold"
+        assert idle_rt.released == 1
+        info = await sched.warm("cold2")
+        assert info["state"] == "warm"
+        assert sched.chips_in_use() == 2
+
+    loop.run_until_complete(go())
+
+
+def test_chip_budget_busy_resident_is_not_a_victim(loop):
+    """A cold_start resident with queued work is never demoted — the
+    budget sheds the newcomer instead of thrashing a loaded model."""
+    async def go():
+        sched = make_sched(chip_budget=4)
+        sched.register("busy", StubBatcher(pending=3),
+                       model_cfg("busy", parallelism="replica",
+                                 cold_start=True),
+                       runtime=FakeRuntime(4, "replica@4"))
+        sched.register("cold1", StubBatcher(),
+                       model_cfg("cold1", cold_start=True),
+                       runtime=FakeRuntime(1, "single"),
+                       warm_fn=noop_warm, cold=True)
+        shed = sched.check_admission("cold1", "interactive")
+        assert shed is not None and shed.reason == "chip_budget"
+        assert sched.state_of("busy") == "warm"
+
+    loop.run_until_complete(go())
+
+
+def test_chip_budget_zero_is_unlimited(loop):
+    async def go():
+        sched = make_sched(chip_budget=0)
+        sched.register("wide", StubBatcher(),
+                       model_cfg("wide", parallelism="replica"),
+                       runtime=FakeRuntime(8, "replica@8"))
+        sched.register("cold", StubBatcher(),
+                       model_cfg("cold", cold_start=True),
+                       runtime=FakeRuntime(8, "replica@8"),
+                       warm_fn=noop_warm, cold=True)
+        shed = sched.check_admission("cold", "interactive")
+        assert shed is not None and shed.reason == "model_warming"
+        await sched.warm("cold")  # let the kicked warm task finish
+
+    loop.run_until_complete(go())
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP: the server builds a group for replica runtimes; /stats shows rows
+# ---------------------------------------------------------------------------
+
+def test_http_replica_group_stats_and_metrics():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from tpuserve.server import ServerState, make_app
+
+    cfg = ServerConfig(
+        decode_threads=2,
+        genserve=GenserveConfig(enabled=True, slots=2, kv_paging=True,
+                                kv_page_tokens=8),
+        parallel=ParallelConfig(mode="replica", n_chips=2),
+        models=[tg_cfg()])
+    state = ServerState(cfg)
+    state.build()
+    assert isinstance(state.engines["tg"], GenEngineGroup)
+
+    async def go():
+        client = TestClient(TestServer(make_app(state)))
+        await client.start_server()
+        try:
+            for i in range(6):
+                r = await client.post(
+                    "/v1/models/tg:generate",
+                    data=json.dumps({"prompt": f"hello mesh {i}", "seed": i,
+                                     "max_new_tokens": 5}),
+                    headers={"Content-Type": "application/json"})
+                assert r.status == 200, await r.text()
+            stats = await (await client.get("/stats")).json()
+            gs = stats["genserve"]["tg"]
+            assert gs["replicas"] == 2 and gs["slots"] == 4
+            rows = gs["per_replica"]
+            assert [r["replica"] for r in rows] == [0, 1]
+            assert all(r["steps_total"] > 0 for r in rows), rows
+            assert all("kv" in r for r in rows)
+            metrics = await (await client.get("/metrics")).text()
+            assert 'gen_replica_steps_total{model="tg",replica="0"}' \
+                in metrics
+            assert 'gen_replica_steps_total{model="tg",replica="1"}' \
+                in metrics
+            assert 'gen_replica_kv_pages_free{model="tg",replica="0"}' \
+                in metrics
+        finally:
+            await client.close()
+
+    run(go())

@@ -618,11 +618,22 @@ class SchedulerConfig:
     warm_retry_after_s: float = 5.0
     # Idle-demotion sweep cadence (s).
     sweep_interval_s: float = 0.5
+    # Chip budget for warm models (ISSUE 20): the scheduler places models
+    # by their parallelism DEGREE (chips a warm runtime occupies — every
+    # replica mesh, or tp x sp x data for a sharded one). Warming a cold
+    # model whose degree would push the warm fleet past this budget first
+    # demotes idle cold_start models to make room, and sheds 503
+    # ``chip_budget`` when room cannot be made. 0 = unlimited (the
+    # pre-budget behavior).
+    chip_budget: int = 0
 
     def __post_init__(self) -> None:
         if self.window_s <= 0 or self.sweep_interval_s <= 0:
             raise ValueError(
                 "scheduler.window_s/sweep_interval_s must be > 0")
+        if self.chip_budget < 0:
+            raise ValueError(
+                f"scheduler.chip_budget must be >= 0, got {self.chip_budget}")
         if not 0.0 <= self.min_share < 0.5:
             raise ValueError(
                 f"scheduler.min_share must be in [0, 0.5), got {self.min_share}")

@@ -14,12 +14,16 @@ generation engine").
   for the paged cache (ISSUE 18; never double-hands a page), with
   :class:`~tpuserve.genserve.engine.KVPressure` as the page-exhaustion
   admission shed.
+- :class:`~tpuserve.genserve.engine.GenEngineGroup` — replica-per-chip
+  engines over a replica-mode runtime (ISSUE 20): one engine per mesh,
+  least-loaded placement, the full engine surface aggregated.
 """
 
 from tpuserve.genserve.arena import SlotArena, SlotCorrupted, SlotInfo
-from tpuserve.genserve.engine import GenEngine, KVPressure
+from tpuserve.genserve.engine import GenEngine, GenEngineGroup, KVPressure
 from tpuserve.genserve.model import GenerativeModel
 from tpuserve.genserve.pages import PageCorrupted, PageLedger
 
-__all__ = ["GenEngine", "GenerativeModel", "KVPressure", "PageCorrupted",
-           "PageLedger", "SlotArena", "SlotCorrupted", "SlotInfo"]
+__all__ = ["GenEngine", "GenEngineGroup", "GenerativeModel", "KVPressure",
+           "PageCorrupted", "PageLedger", "SlotArena", "SlotCorrupted",
+           "SlotInfo"]

@@ -41,6 +41,7 @@ import asyncio
 import collections
 import logging
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -54,6 +55,7 @@ from tpuserve.genserve.model import GenerativeModel
 from tpuserve.genserve.pages import PageLedger
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.obs import GEN_STREAM_REASONS, PRIORITIES, Metrics
+from tpuserve.utils.locks import new_lock
 from tpuserve.utils.retrace import allow_transfers, host_fetch
 
 log = logging.getLogger("tpuserve.genserve")
@@ -142,7 +144,8 @@ class GenEngine:
                  breaker: "Any | None" = None,
                  injector: "Any | None" = None,
                  stages: "StageExecutors | None" = None,
-                 pipeline_cfg: "PipelineConfig | None" = None) -> None:
+                 pipeline_cfg: "PipelineConfig | None" = None,
+                 replica: int = 0) -> None:
         self.model = model
         self.runtime = runtime
         self.metrics = metrics
@@ -150,6 +153,19 @@ class GenEngine:
         self.gcfg = gcfg or GenserveConfig()
         self.breaker = breaker
         self.injector = injector
+        # Replica identity (ISSUE 20): which runtime mesh this engine's
+        # dispatches ride. 0 for single/sharded; a GenEngineGroup builds
+        # one engine per replica mesh and sets ``peers`` so model-level
+        # gauges publish group-wide sums instead of last-writer-wins.
+        self.replica = int(replica)
+        self.peers: "list[GenEngine] | None" = None
+        # CPU-backend wedge guard (ISSUE 11, batcher device sections):
+        # concurrent dispatches from several replica engines' stage threads
+        # spin-wait against each other on forced-host-device meshes. The
+        # group installs ONE shared lock on the cpu backend; real
+        # accelerator backends (and single engines) keep this None — the
+        # step loop stays lock-free there.
+        self._dispatch_lock = None
         self.slots = self.gcfg.slots or max(self.cfg.batch_buckets)
         self.arena = SlotArena(self.slots)
         # Paged KV cache (ISSUE 18): only families that ship the paged
@@ -234,10 +250,22 @@ class GenEngine:
         # Fleet device-time ledger hook (tpuserve.scheduler): called with
         # each compiled step's seconds when a scheduler is attached.
         self.device_time_cb = None
-        # Device-seconds ledger (ISSUE 14): the engine is single-mesh, so
-        # all step time lands on the replica-0 row; the telemetry sampler
-        # derives device_utilization{model=,replica=0} from its rate.
-        self._c_device_seconds = metrics.device_seconds_counter(name, 0)
+        # Device-seconds ledger (ISSUE 14): step time lands on THIS
+        # engine's replica row; the telemetry sampler derives
+        # device_utilization{model=,replica=} from its rate.
+        self._c_device_seconds = metrics.device_seconds_counter(
+            name, self.replica)
+        # Per-replica engine ledger (ISSUE 20): steps/units/occupancy rows
+        # keyed {model=,replica=} — prebound so the telemetry sampler
+        # captures them into /stats/history from the first scrape.
+        self._c_replica_steps = metrics.gen_replica_steps_counter(
+            name, self.replica)
+        self._c_replica_units = metrics.gen_replica_units_counter(
+            name, self.replica)
+        self._g_replica_active = metrics.gen_replica_active_gauge(
+            name, self.replica)
+        self._g_replica_kv_free = metrics.gen_replica_kv_free_gauge(
+            name, self.replica)
         self._pending: collections.deque[_GenRequest] = collections.deque()
         self._state: Any = None
         self._state_struct: Any = None
@@ -300,6 +328,24 @@ class GenEngine:
             return
         item_struct = model.gen_item_signature()
         slot_struct = jax.ShapeDtypeStruct((), np.int32)
+        # Sharded decode (ISSUE 20): on a sharded mesh the family may pin
+        # state-block dims to mesh axes (textgen: KV heads on "model").
+        # The SAME spec tree goes in as the state arg's sharding and out
+        # as the state output's sharding — the state feeds back through
+        # the AOT executable, and Compiled demands exact input shardings.
+        from jax.sharding import PartitionSpec as P
+        sspecs = None
+        if getattr(rt, "mode", "single") == "sharded":
+            sspecs = model.state_partition_specs(self._state_struct,
+                                                 rt.meshes[0])
+
+        def _specs(n_extra: int) -> dict:
+            """register_program spec kwargs for (state, *n_extra args)."""
+            if sspecs is None:
+                return {}
+            return {"arg_specs": (sspecs,) + (None,) * n_extra,
+                    "out_specs": sspecs}
+
         if self.paging:
             start_struct = jax.ShapeDtypeStruct((), np.int32)
             pages_struct = jax.ShapeDtypeStruct((self._pps,), np.int32)
@@ -312,7 +358,8 @@ class GenEngine:
             rt.register_program("prefill", prefill_fn,
                                 (self._state_struct, slot_struct,
                                  item_struct, start_struct, pages_struct),
-                                width=self.slots, donate_argnums=(0,))
+                                width=self.slots, donate_argnums=(0,),
+                                **_specs(4))
         else:
             def insert_fn(params, state, slot, item):
                 fresh = model.init_state(params, item)
@@ -324,35 +371,48 @@ class GenEngine:
             rt.register_program("insert", insert_fn,
                                 (self._state_struct, slot_struct,
                                  item_struct),
-                                width=self.slots, donate_argnums=(0,))
+                                width=self.slots, donate_argnums=(0,),
+                                **_specs(2))
+        step_specs = {} if sspecs is None else {
+            "arg_specs": (sspecs,), "out_specs": (sspecs, P())}
         rt.register_program("step", model.step, (self._state_struct,),
-                            width=self.slots, donate_argnums=(0,))
+                            width=self.slots, donate_argnums=(0,),
+                            **step_specs)
         rt.register_program("extract", model.extract,
                             (self._state_struct, slot_struct),
-                            width=self.slots)
+                            width=self.slots,
+                            **({} if sspecs is None
+                               else {"arg_specs": (sspecs, None)}))
         rt.gen_meta = geometry
         # Prewarm: one full fold-in + step + extract on a zero state block,
         # with a dependent read per program (the only honest completion
         # signal). Paged mode walks every prefill chunk of the canary so
-        # the chunked program loads too.
-        state = self._host_zeros(self._state_struct)
+        # the chunked program loads too. EVERY replica mesh prewarms —
+        # PJRT program load must come off replica k's first request too,
+        # not just replica 0's.
         item = model.canary_item()
-        if self.paging:
-            row = np.arange(1, self._pps + 1, dtype=np.int32)
-            n_prompt = model.prompt_tokens(item)
-            start = 0
-            while True:
-                state = rt.run_program("prefill", state, np.int32(0), item,
-                                       np.int32(start), row)
-                start += self._prefill_chunk
-                if start >= n_prompt:
-                    break
-        else:
-            state = rt.run_program("insert", state, np.int32(0), item)
-        state, out = rt.run_program("step", state)
-        jax.tree_util.tree_map(np.asarray, out)
-        jax.tree_util.tree_map(
-            np.asarray, rt.run_program("extract", state, np.int32(0)))
+        for r in range(getattr(rt, "n_replicas", 1)):
+            state = self._host_zeros(self._state_struct)
+            with self._dispatch_guard():
+                if self.paging:
+                    row = np.arange(1, self._pps + 1, dtype=np.int32)
+                    n_prompt = model.prompt_tokens(item)
+                    start = 0
+                    while True:
+                        state = rt.run_program("prefill", state, np.int32(0),
+                                               item, np.int32(start), row,
+                                               replica=r)
+                        start += self._prefill_chunk
+                        if start >= n_prompt:
+                            break
+                else:
+                    state = rt.run_program("insert", state, np.int32(0),
+                                           item, replica=r)
+                state, out = rt.run_program("step", state, replica=r)
+                jax.tree_util.tree_map(np.asarray, out)
+                jax.tree_util.tree_map(
+                    np.asarray,
+                    rt.run_program("extract", state, np.int32(0), replica=r))
         log.info("%s: generation engine compiled+prewarmed %d slots in %.1fs",
                  self.name, self.slots, time.perf_counter() - t0)
 
@@ -365,7 +425,10 @@ class GenEngine:
     async def start(self) -> None:
         self._state = self._host_zeros(self._state_struct)
         if self.pages is not None:
-            self._g_kv_pages_total.set(float(self.pages.usable))
+            peers = [e for e in (self.peers or [self])
+                     if e.pages is not None]
+            self._g_kv_pages_total.set(
+                float(sum(e.pages.usable for e in peers)))
             self._update_kv_gauges()
         self._work_event = asyncio.Event()
         self._idle_event = asyncio.Event()
@@ -400,8 +463,8 @@ class GenEngine:
         if self.pages is not None:
             self.pages.release_all()
             self._update_kv_gauges()
-        self._g_queue_depth.set(0)
-        self._g_active.set(0)
+        self._publish_queue_depth()
+        self._publish_active()
         self._maybe_idle()
         if self._own_stages:
             self.stages.shutdown()
@@ -512,7 +575,7 @@ class GenEngine:
             item=item, future=fut, enqueued_at=time.perf_counter(),
             deadline_at=deadline_at, priority=priority, ctx=ctx,
             stream=stream, pages_needed=need))
-        self._g_queue_depth.set(len(self._pending))
+        self._publish_queue_depth()
         self._idle_event.clear()
         self._work_event.set()
         return fut
@@ -634,6 +697,26 @@ class GenEngine:
                 and not self.arena.n_active:
             self._idle_event.set()
 
+    # -- gauge publication (event loop) ---------------------------------------
+    # Metrics are name-keyed singletons: every engine in a replica group
+    # binds the SAME gen_active_slots{model=} handle, so model-level
+    # gauges must publish the group-wide value (peers sum) — last-writer-
+    # wins would make the gauge flap with whichever replica updated last.
+    # Per-replica truth lives on the {model=,replica=} rows. All engines
+    # of a group share one event loop, so the sums are consistent.
+    def _publish_active(self) -> None:
+        n = self.arena.n_active
+        self._g_replica_active.set(float(n))
+        peers = self.peers
+        self._g_active.set(float(n) if peers is None
+                           else float(sum(e.arena.n_active for e in peers)))
+
+    def _publish_queue_depth(self) -> None:
+        peers = self.peers
+        n = (len(self._pending) if peers is None
+             else sum(len(e._pending) for e in peers))
+        self._g_queue_depth.set(float(n))
+
     # -- page ledger plumbing (event loop; ISSUE 18) --------------------------
     def _release_slot(self, slot: int) -> SlotInfo:
         """EVERY slot-release path funnels through here so the slot's KV
@@ -648,8 +731,13 @@ class GenEngine:
         return self.arena.release(slot)
 
     def _update_kv_gauges(self) -> None:
-        self._g_kv_pages_free.set(float(self.pages.n_free))
-        self._g_kv_util.set(self.pages.utilization())
+        self._g_replica_kv_free.set(float(self.pages.n_free))
+        peers = [e for e in (self.peers or [self]) if e.pages is not None]
+        usable = sum(e.pages.usable for e in peers)
+        self._g_kv_pages_free.set(float(sum(e.pages.n_free for e in peers)))
+        self._g_kv_util.set(
+            sum(e.pages.n_reserved for e in peers) / usable if usable
+            else 0.0)
 
     def _queued_pages(self) -> int:
         """Pages the already-accepted queue will reserve once admitted
@@ -724,6 +812,7 @@ class GenEngine:
                 if self.device_time_cb is not None:
                     self.device_time_cb(step_ms / 1e3)
                 self._c_iterations.inc()
+                self._c_replica_steps.inc()
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — contained per batch
@@ -732,21 +821,34 @@ class GenEngine:
             await self._emit_step_units(out)
             await self._retire(out)
 
+    def _dispatch_guard(self):
+        """Context for one device-dispatch section: the group's shared
+        CPU-backend lock when installed (see __init__), else a no-op.
+        Sync-only sections — no await ever runs under it, so the lock
+        witness has nothing to flag."""
+        lock = self._dispatch_lock
+        return lock if lock is not None else nullcontext()
+
     def _step_sync(self) -> dict:
         """One compiled iteration over the slot block + the small host
         fetch of the out pytree. Runs on the fetch stage executor."""
-        self._state, out = self.runtime.run_program("step", self._state)
-        return host_fetch(out)
+        with self._dispatch_guard():
+            self._state, out = self.runtime.run_program(
+                "step", self._state, replica=self.replica)
+            return host_fetch(out)
 
     def _insert_sync(self, slot: int, item: Any) -> None:
-        self._state = self.runtime.run_program(
-            "insert", self._state, np.int32(slot), item)
+        with self._dispatch_guard():
+            self._state = self.runtime.run_program(
+                "insert", self._state, np.int32(slot), item,
+                replica=self.replica)
 
     def _prefill_sync(self, slot: int, item: Any, start: int,
                       pages_row: np.ndarray) -> None:
-        self._state = self.runtime.run_program(
-            "prefill", self._state, np.int32(slot), item, np.int32(start),
-            pages_row)
+        with self._dispatch_guard():
+            self._state = self.runtime.run_program(
+                "prefill", self._state, np.int32(slot), item,
+                np.int32(start), pages_row, replica=self.replica)
 
     async def _prefill_advance(self, slot: int, info: SlotInfo) -> None:
         """Fold ONE more prompt chunk for a prefilling slot (runs on the
@@ -788,8 +890,11 @@ class GenEngine:
                 return
 
     def _extract_sync(self, slot: int) -> Any:
-        return host_fetch(
-            self.runtime.run_program("extract", self._state, np.int32(slot)))
+        with self._dispatch_guard():
+            return host_fetch(
+                self.runtime.run_program("extract", self._state,
+                                         np.int32(slot),
+                                         replica=self.replica))
 
     # -- scheduling passes ----------------------------------------------------
     def _expire_pending(self) -> None:
@@ -826,7 +931,7 @@ class GenEngine:
             self._c_deadline.inc(n_expired)
         if len(live) != len(self._pending):
             self._pending = live
-            self._g_queue_depth.set(len(live))
+            self._publish_queue_depth()
 
     def _evict_expired(self) -> None:
         """Mid-generation deadline eviction: a slot whose request deadline
@@ -879,7 +984,7 @@ class GenEngine:
                                   slot=slot, iterations=info.iterations,
                                   reason="drain")
                 self._release_slot(slot)
-        self._g_active.set(self.arena.n_active)
+        self._publish_active()
 
     async def _admit(self) -> None:
         """Fold queued requests into free slots — mid-flight when the block
@@ -888,7 +993,7 @@ class GenEngine:
         admitted = 0
         while self.arena.n_free and self._pending and admitted < cap:
             req = self._pending.popleft()
-            self._g_queue_depth.set(len(self._pending))
+            self._publish_queue_depth()
             if req.future.done():
                 continue
             now = time.perf_counter()
@@ -905,7 +1010,7 @@ class GenEngine:
                 # skipping ahead would starve long-context requests); the
                 # admission-time pressure check bounds how long.
                 self._pending.appendleft(req)
-                self._g_queue_depth.set(len(self._pending))
+                self._publish_queue_depth()
                 break
             fold = any(self.arena.peek(s).iterations > 0
                        for s in self.arena.active_slots())
@@ -977,7 +1082,7 @@ class GenEngine:
             admitted += 1
             if fold:
                 self._c_fold_ins.inc()
-        self._g_active.set(self.arena.n_active)
+        self._publish_active()
 
     async def _retire(self, out: dict) -> None:
         """Account the iteration and retire every finished slot
@@ -1049,7 +1154,9 @@ class GenEngine:
                 if not info.future.done():
                     info.future.set_result(result)
                 self._c_items.inc()
-                self._c_units.inc(self.model.result_units(result))
+                units = self.model.result_units(result)
+                self._c_units.inc(units)
+                self._c_replica_units.inc(units)
                 self._observe_retire(info.iterations)
                 if early:
                     self._c_early_exits.inc()
@@ -1068,7 +1175,7 @@ class GenEngine:
                     tid=self.name, trace_id=trace_id, slot=slot,
                     iterations=info.iterations)
             self._release_slot(slot)
-        self._g_active.set(self.arena.n_active)
+        self._publish_active()
         self._maybe_idle()
 
     async def _fail_active(self, e: Exception) -> None:
@@ -1093,7 +1200,7 @@ class GenEngine:
             self.pages.release_all()
             self._update_kv_gauges()
         self._state = self._host_zeros(self._state_struct)
-        self._g_active.set(0)
+        self._publish_active()
         self._maybe_idle()
 
     # -- staged canary (lifecycle hook; runs in an executor thread) -----------
@@ -1106,35 +1213,38 @@ class GenEngine:
         (tpuserve.lifecycle wires this in place of the one-shot
         staged-canary path for engine-served models)."""
         model, rt = self.model, self.runtime
+        r = self.replica
         item = model.canary_item()
         state = self._host_zeros(self._state_struct)
-        if self.paging:
-            row = np.arange(1, self._pps + 1, dtype=np.int32)
-            n_prompt = model.prompt_tokens(item)
-            start = 0
-            while True:
-                state = rt.run_program("prefill", state, np.int32(0), item,
-                                       np.int32(start), row,
-                                       params_override=staged)
-                start += self._prefill_chunk
-                if start >= n_prompt:
+        with self._dispatch_guard():
+            if self.paging:
+                row = np.arange(1, self._pps + 1, dtype=np.int32)
+                n_prompt = model.prompt_tokens(item)
+                start = 0
+                while True:
+                    state = rt.run_program("prefill", state, np.int32(0),
+                                           item, np.int32(start), row,
+                                           params_override=staged, replica=r)
+                    start += self._prefill_chunk
+                    if start >= n_prompt:
+                        break
+            else:
+                state = rt.run_program("insert", state, np.int32(0), item,
+                                       params_override=staged, replica=r)
+            for _ in range(self._max_steps_guard):
+                state, out = rt.run_program("step", state,
+                                            params_override=staged, replica=r)
+                with allow_transfers():  # deliberate: canary progress read
+                    done = bool(np.asarray(out["done"])[0])
+                if done:
                     break
-        else:
-            state = rt.run_program("insert", state, np.int32(0), item,
-                                   params_override=staged)
-        for _ in range(self._max_steps_guard):
-            state, out = rt.run_program("step", state, params_override=staged)
-            with allow_transfers():  # deliberate: canary progress read
-                done = bool(np.asarray(out["done"])[0])
-            if done:
-                break
-        else:
-            raise ValueError(
-                f"staged canary did not finish a generation within "
-                f"{self._max_steps_guard} iterations")
-        extracted = host_fetch(
-            rt.run_program("extract", state, np.int32(0),
-                           params_override=staged))
+            else:
+                raise ValueError(
+                    f"staged canary did not finish a generation within "
+                    f"{self._max_steps_guard} iterations")
+            extracted = host_fetch(
+                rt.run_program("extract", state, np.int32(0),
+                               params_override=staged, replica=r))
         for path, leaf in jax.tree_util.tree_flatten_with_path(extracted)[0]:
             arr = np.asarray(leaf)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
@@ -1236,7 +1346,27 @@ class GenEngine:
                 "queued_pages": self._queued_pages(),
                 "kv_bytes": self.kv_cache_bytes(),
             }
+        # Per-replica rows (ISSUE 20): one row for a single engine, one per
+        # member for a GenEngineGroup (which overrides the aggregate keys
+        # above and composes these) — uniform shape either way.
+        stats["per_replica"] = [self.replica_row()]
         return stats
+
+    def replica_row(self) -> dict:
+        """One engine's row of the /stats genserve ``per_replica`` block:
+        slots in use, steps, units, and page-pool occupancy."""
+        row = {
+            "replica": self.replica,
+            "slots": self.slots,
+            "active": self.arena.n_active,
+            "free": self.arena.n_free,
+            "pending": len(self._pending),
+            "steps_total": self._c_replica_steps.value,
+            "units_total": self._c_replica_units.value,
+        }
+        if self.pages is not None:
+            row["kv"] = self.pages.snapshot()
+        return row
 
     def kv_cache_bytes(self) -> int:
         """Device bytes the KV storage leaves occupy (dense slab k/v or the
@@ -1250,3 +1380,239 @@ class GenEngine:
                     total += (int(np.prod(leaf.shape))
                               * np.dtype(leaf.dtype).itemsize)
         return total
+
+
+class GenEngineGroup:
+    """Replica-per-chip generation engines over one replica-mode runtime
+    (ISSUE 20; AlpaServe P5's parallelism-as-serving-lever applied to the
+    generation pillar).
+
+    One :class:`GenEngine` per replica mesh, each owning its own slot
+    arena, page ledger, and device state block on its own chip, all
+    sharing the runtime's compiled program registry (register_program
+    compiles each program once per replica mesh, so `runtime_compiles_
+    total` counts chips x programs at startup and 0 forever after — the
+    same zero-recompile obligation, now per chip). The group exposes the
+    full engine surface (submit/submit_stream/start/stop/drain/
+    revive_group_loops/pipeline_stats/staged_canary_sync/scheduler
+    predictors), so every downstream consumer — HTTP layer, watchdog,
+    lifecycle, fleet scheduler, /stats — composes unchanged.
+
+    Placement is least-loaded: a request goes to the engine with the
+    fewest committed items (active slots + queued), ties rotating, so a
+    replica pinned by long generations never starves the others. Model-
+    level counters are name-keyed singletons shared by every member;
+    per-replica truth lives on the {model=,replica=} rows and the
+    ``per_replica`` stats block."""
+
+    def __init__(self, model: GenerativeModel, runtime: Any,
+                 metrics: Metrics, gcfg: "GenserveConfig | None" = None,
+                 breaker: "Any | None" = None,
+                 injector: "Any | None" = None,
+                 stages: "StageExecutors | None" = None,
+                 pipeline_cfg: "PipelineConfig | None" = None) -> None:
+        n = int(getattr(runtime, "n_replicas", 1))
+        self.model = model
+        self.runtime = runtime
+        self.metrics = metrics
+        self.cfg = model.cfg
+        self.gcfg = gcfg or GenserveConfig()
+        self.name = model.cfg.name
+        self._own_stages = stages is None
+        self.stages = stages if stages is not None \
+            else StageExecutors(pipeline_cfg or PipelineConfig(), metrics)
+        self.engines = [
+            GenEngine(model, runtime, metrics, gcfg=self.gcfg,
+                      breaker=breaker, injector=injector, stages=self.stages,
+                      pipeline_cfg=pipeline_cfg, replica=i)
+            for i in range(n)]
+        for e in self.engines:
+            e.peers = self.engines
+        if n > 1 and jax.default_backend() == "cpu":
+            # Shared dispatch lock: see GenEngine.__init__ (ISSUE 11's
+            # forced-host-device wedge, the replica-engine form).
+            lock = new_lock("genserve.cpu_dispatch")
+            for e in self.engines:
+                e._dispatch_lock = lock
+        self._rr = 0
+
+    # -- pass-through configuration (server wiring sets these post-build) -----
+    @property
+    def injector(self) -> Any:
+        return self.engines[0].injector
+
+    @injector.setter
+    def injector(self, inj: Any) -> None:
+        for e in self.engines:
+            e.injector = inj
+
+    @property
+    def breaker(self) -> Any:
+        return self.engines[0].breaker
+
+    @breaker.setter
+    def breaker(self, br: Any) -> None:
+        for e in self.engines:
+            e.breaker = br
+
+    @property
+    def device_time_cb(self) -> Any:
+        return self.engines[0].device_time_cb
+
+    @device_time_cb.setter
+    def device_time_cb(self, cb: Any) -> None:
+        # Every engine feeds the same fleet ledger: the model's device
+        # seconds are the sum of its replicas' step time.
+        for e in self.engines:
+            e.device_time_cb = cb
+
+    # -- aggregates -----------------------------------------------------------
+    @property
+    def slots(self) -> int:
+        return sum(e.slots for e in self.engines)
+
+    @property
+    def peak_active(self) -> int:
+        return sum(e.peak_active for e in self.engines)
+
+    @property
+    def pending(self) -> int:
+        return sum(e.pending for e in self.engines)
+
+    @property
+    def paging(self) -> bool:
+        return self.engines[0].paging
+
+    def kv_cache_bytes(self) -> int:
+        return sum(e.kv_cache_bytes() for e in self.engines)
+
+    # -- lifecycle ------------------------------------------------------------
+    def compile(self) -> None:
+        """First engine registers the programs (compiled once per replica
+        mesh) and prewarms every replica; the rest validate geometry
+        against the registry and reuse."""
+        for e in self.engines:
+            e.compile()
+
+    async def start(self) -> None:
+        for e in self.engines:
+            await e.start()
+
+    async def stop(self) -> None:
+        for e in self.engines:
+            await e.stop()
+        if self._own_stages:
+            self.stages.shutdown()
+
+    async def drain(self, deadline: float) -> bool:
+        results = await asyncio.gather(
+            *(e.drain(deadline) for e in self.engines))
+        return all(results)
+
+    def revive_group_loops(self) -> int:
+        return sum(e.revive_group_loops() for e in self.engines)
+
+    # -- submission (event loop) ----------------------------------------------
+    def _pick(self) -> GenEngine:
+        """Least-loaded engine by committed work (active + queued); ties
+        rotate a cursor so idle replicas share cold traffic — the engine
+        twin of ModelRuntime.pick_replica."""
+        n = len(self.engines)
+        best, best_load = self.engines[self._rr % n], None
+        for k in range(n):
+            e = self.engines[(self._rr + k) % n]
+            load = e.arena.n_active + len(e._pending)
+            if best_load is None or load < best_load:
+                best, best_load = e, load
+        self._rr = (self._rr + 1) % n
+        return best
+
+    def submit(self, item: Any, group: Any = None,
+               deadline_at: float | None = None,
+               priority: str | None = None,
+               ctx: Any = None) -> asyncio.Future:
+        return self._pick().submit(item, group=group, deadline_at=deadline_at,
+                                   priority=priority, ctx=ctx)
+
+    def submit_stream(self, item: Any, deadline_at: float | None = None,
+                      priority: str | None = None,
+                      ctx: Any = None) -> "tuple[asyncio.Future, GenStream]":
+        return self._pick().submit_stream(item, deadline_at=deadline_at,
+                                          priority=priority, ctx=ctx)
+
+    # -- staged canary (lifecycle hook; executor thread) ----------------------
+    def staged_canary_sync(self, staged: list[Any]) -> None:
+        """Fan the staged canary to EVERY replica engine — each runs the
+        short real generation against ITS mesh's staged tree, so a
+        candidate that loads clean on replica 0 but broken on replica 3
+        is rejected before publish. Failure names the replica (the
+        lifecycle surfaces the message through /admin reload errors)."""
+        for i, e in enumerate(self.engines):
+            try:
+                e.staged_canary_sync(staged)
+            except Exception as err:
+                raise ValueError(
+                    f"staged canary failed on replica {i}: {err}") from err
+
+    # -- scheduler surface ----------------------------------------------------
+    def predicted_service_s(self, n_items: int = 1) -> float | None:
+        vals = [v for e in self.engines
+                if (v := e.predicted_service_s(n_items)) is not None]
+        return (sum(vals) / len(vals)) if vals else None
+
+    def kv_clear_s(self) -> float | None:
+        vals = [v for e in self.engines
+                if (v := e.kv_clear_s()) is not None]
+        return max(vals) if vals else None
+
+    def estimate_clear_s(self) -> float | None:
+        # Replicas drain in parallel: the group clears when its slowest
+        # member does.
+        vals = [v for e in self.engines
+                if (v := e.estimate_clear_s()) is not None]
+        return max(vals) if vals else None
+
+    # -- introspection --------------------------------------------------------
+    def pipeline_stats(self) -> dict:
+        e0 = self.engines[0]
+        # Model-level counters are singletons — e0's handles already carry
+        # group totals; only the occupancy fields need summing.
+        stats = e0.pipeline_stats()
+        stats.update(
+            replicas=len(self.engines),
+            slots=self.slots,
+            active=sum(e.arena.n_active for e in self.engines),
+            free=sum(e.arena.n_free for e in self.engines),
+            peak_active=self.peak_active,
+            pending=self.pending,
+            admitted_total=sum(e.arena.acquires_total for e in self.engines),
+        )
+        ewmas = [e._ewma_step_ms for e in self.engines if e._ewma_step_ms]
+        stats["step_ewma_ms"] = (round(sum(ewmas) / len(ewmas), 3)
+                                 if ewmas else None)
+        iters = [e._ewma_iters for e in self.engines if e._ewma_iters]
+        stats["iters_per_request_ewma"] = (round(sum(iters) / len(iters), 2)
+                                           if iters else None)
+        stats["per_slot"] = [
+            {"replica": e.replica, "slot": s,
+             "iterations": e.arena.peek(s).iterations}
+            for e in self.engines for s in e.arena.active_slots()]
+        if e0.pages is not None:
+            paged = [e for e in self.engines if e.pages is not None]
+            usable = sum(e.pages.usable for e in paged)
+            reserved = sum(e.pages.n_reserved for e in paged)
+            stats["kv"] = {
+                "pages": sum(e.pages.pages for e in paged),
+                "usable": usable,
+                "free": sum(e.pages.n_free for e in paged),
+                "reserved": reserved,
+                "page_tokens": e0.pages.page_tokens,
+                "utilization": round(reserved / usable, 4) if usable else 0.0,
+                "acquires_total": sum(e.pages.acquires_total for e in paged),
+                "prefill_chunk": e0._prefill_chunk,
+                "prefill_chunks_total": e0._c_prefill_chunks.value,
+                "queued_pages": sum(e._queued_pages() for e in paged),
+                "kv_bytes": self.kv_cache_bytes(),
+            }
+        stats["per_replica"] = [e.replica_row() for e in self.engines]
+        return stats

@@ -86,6 +86,16 @@ class GenerativeModel(ServingModel):
         device outputs (one compile covers every slot). Runs once per
         retirement — put the heavy tail work here (e.g. the VAE decode)."""
 
+    def state_partition_specs(self, struct: Any, mesh: Any) -> Any:
+        """PartitionSpec tree (or None = replicate everything) for the
+        engine's device state block on a SHARDED mesh (ISSUE 20). Families
+        that can split decode across chips override — textgen puts KV
+        heads on "model" beside its QKV column shards — and the engine
+        threads the result through ``register_program``'s arg/out specs so
+        the state block never materializes unsharded. Returning None keeps
+        the replicated layout (correct for every family, the default)."""
+        return None
+
     # -- host contract --------------------------------------------------------
     def gen_max_steps(self) -> int:
         """Upper bound on iterations any single request can take (the

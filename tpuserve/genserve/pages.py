@@ -134,6 +134,17 @@ class PageLedger:
             n += len(self.release(slot))
         return n
 
+    def snapshot(self) -> dict:
+        """Compact live-occupancy row for the per-replica /stats block
+        (ISSUE 20) — just the pool's current fill, not the full stats()
+        geometry dump."""
+        return {
+            "free": self.n_free,
+            "reserved": self.n_reserved,
+            "usable": self.usable,
+            "utilization": round(self.utilization(), 4),
+        }
+
     def stats(self) -> dict:
         return {
             "pages": self.pages,

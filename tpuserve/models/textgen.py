@@ -39,9 +39,11 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from tpuserve.config import ModelConfig
 from tpuserve.genserve.model import GenerativeModel
+from tpuserve.parallel.mesh import MODEL_AXIS, SEQ_AXIS, can_shard
 from tpuserve.text import WordPieceTokenizer, synthetic_vocab
 
 
@@ -82,6 +84,13 @@ class TextGenServing(GenerativeModel):
         if self.attention not in ("dense", "flash"):
             raise ValueError("options.attention must be 'dense' or 'flash', "
                              f"got {self.attention!r}")
+        # Switch-MoE FFN variant (ISSUE 20): 0 = dense MLP (the default and
+        # the historical RNG stream); >= 2 replaces every layer's MLP with
+        # top-1 routing over ops.moe.switch_route.
+        self.moe_experts = int(o.get("moe_experts", 0))
+        if self.moe_experts == 1 or self.moe_experts < 0:
+            raise ValueError("options.moe_experts must be 0 (dense MLP) "
+                             f"or >= 2 experts, got {self.moe_experts}")
         if self.attention == "flash" and self.max_prompt % 8:
             raise ValueError(
                 f"options.attention='flash' needs prompt_len "
@@ -104,7 +113,10 @@ class TextGenServing(GenerativeModel):
             return (jax.random.normal(key, shape, jnp.float32)
                     * (1.0 / math.sqrt(shape[0]))).astype(jnp.float32)
 
-        keys = iter(jax.random.split(rng, 6 * self.layers + 4))
+        # Key budget: dense layers draw 6, MoE layers 7 — dense configs keep
+        # the historical RNG stream bit-for-bit.
+        per_layer = 7 if self.moe_experts else 6
+        keys = iter(jax.random.split(rng, per_layer * self.layers + 4))
         params: dict = {
             "embed": jax.random.normal(next(keys), (v, d), jnp.float32) * 0.02,
             "pos": jax.random.normal(next(keys), (self.max_ctx, d),
@@ -114,7 +126,7 @@ class TextGenServing(GenerativeModel):
             "head": dense(next(keys), (d, v)),
         }
         for i in range(self.layers):
-            params[f"layer{i}"] = {
+            lp = {
                 "ln1": {"scale": jnp.ones((d,), jnp.float32),
                         "bias": jnp.zeros((d,), jnp.float32)},
                 "wq": dense(next(keys), (d, h * hd)),
@@ -123,10 +135,71 @@ class TextGenServing(GenerativeModel):
                 "wo": dense(next(keys), (h * hd, d)),
                 "ln2": {"scale": jnp.ones((d,), jnp.float32),
                         "bias": jnp.zeros((d,), jnp.float32)},
-                "w_up": dense(next(keys), (d, f)),
-                "w_down": dense(next(keys), (f, d)),
             }
+            if self.moe_experts:
+                e = self.moe_experts
+                lp["router"] = dense(next(keys), (d, e))
+                lp["moe_up"] = (
+                    jax.random.normal(next(keys), (e, d, f), jnp.float32)
+                    * (1.0 / math.sqrt(d)))
+                lp["moe_down"] = (
+                    jax.random.normal(next(keys), (e, f, d), jnp.float32)
+                    * (1.0 / math.sqrt(f)))
+            else:
+                lp["w_up"] = dense(next(keys), (d, f))
+                lp["w_down"] = dense(next(keys), (f, d))
+            params[f"layer{i}"] = lp
         return params
+
+    # -- parallelism (ISSUE 20: sharded decode) -------------------------------
+    def partition_rules(self) -> list[tuple[str, P]]:
+        """TP rules for sharded decode: attention QKV and the vocab head
+        shard columns (the heads / vocab dim) on "model", the out
+        projection shards rows (its contraction dim); MoE expert weights
+        shard the leading expert dim. Embeddings, positions, and norms
+        replicate — they are small and read by every shard. tp <= 1 keeps
+        everything replicated (the historical layout)."""
+        if self.cfg.tp <= 1:
+            return [(".*", P())]
+        return [
+            (r"w[qkv]$", P(None, MODEL_AXIS)),
+            (r"wo$", P(MODEL_AXIS, None)),
+            (r"w_up$", P(None, MODEL_AXIS)),
+            (r"w_down$", P(MODEL_AXIS, None)),
+            (r"router$", P()),
+            (r"moe_(up|down)$", P(MODEL_AXIS, None, None)),
+            (r"head$", P(None, MODEL_AXIS)),
+            (r".*", P()),
+        ]
+
+    def state_partition_specs(self, struct: Any, mesh: Any) -> Any:
+        """PartitionSpec tree for the engine's device state block on a
+        sharded mesh: the KV heads dim rides "model" next to the QKV
+        column shards (each shard decodes its own heads), and the
+        pages/context dim rides "seq" when sequence parallelism is on.
+        Dims that don't divide the axis fall back to replication
+        (``can_shard``), and an all-replicated layout returns None so the
+        caller skips spec plumbing entirely. Lane bookkeeping (tokens,
+        pos, done, ...) always replicates — every shard must agree on
+        done flags for the emission path."""
+        specs = {f: P() for f in struct}
+        if "kp" in struct:  # tps-ok[TPS503]: host-side structural check
+            kv = [None, None, None, None, None]  # (pages, ln, pt, h, hd)
+            if can_shard(mesh, MODEL_AXIS, self.heads):
+                kv[3] = MODEL_AXIS
+            if can_shard(mesh, SEQ_AXIS, int(struct["kp"].shape[0])):
+                kv[0] = SEQ_AXIS
+            specs["kp"] = specs["vp"] = P(*kv)
+        else:
+            kv = [None, None, None, None, None]  # (slots, ln, ctx, h, hd)
+            if can_shard(mesh, MODEL_AXIS, self.heads):
+                kv[3] = MODEL_AXIS
+            if can_shard(mesh, SEQ_AXIS, self.max_ctx):
+                kv[2] = SEQ_AXIS
+            specs["k"] = specs["v"] = P(*kv)
+        if all(s == P() for s in specs.values()):
+            return None
+        return specs
 
     # -- shapes ---------------------------------------------------------------
     def input_signature(self, bucket: tuple) -> Any:
@@ -199,6 +272,44 @@ class TextGenServing(GenerativeModel):
         return (_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
                 .astype(jnp.float32) @ params["head"].astype(jnp.float32))
 
+    def _mlp(self, lp, hx, dt):
+        """The position-wise FFN delta for a normed hidden block ``hx``
+        (..., d) — the dense gelu MLP, or the Switch-MoE twin when
+        ``options.moe_experts`` > 0. One seam shared by all four forward
+        bodies (prefill / decode / paged-chunk / paged-decode), so the MoE
+        variant inherits every serving path at once."""
+        if not self.moe_experts:
+            return (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
+                    @ lp["w_down"].astype(dt))
+        return self._moe_ffn(lp, hx, dt)
+
+    def _moe_ffn(self, lp, hx, dt):
+        """Top-1 Switch FFN over ``ops.moe.switch_route`` with GROUP SIZE
+        ONE: every token routes independently with capacity 1, so no token
+        is ever dropped and a lane's FFN output is a function of that lane
+        alone. A batch-global capacity would let slot A's routing evict
+        slot B's token — fine for training throughput, wrong for serving,
+        where results must be independent of batch composition (the
+        invariant every engine parity test gates on). Expert weights carry
+        a leading (E, ...) dim sharded on "model" under TP — expert
+        parallelism via shardings, no hand-written collectives."""
+        from tpuserve.ops.moe import switch_route
+
+        lead, d = hx.shape[:-1], hx.shape[-1]
+        xt = hx.reshape(-1, d)
+        logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                            lp["router"].astype(jnp.float32))
+        dispatch, combine, _aux = jax.vmap(
+            lambda lg: switch_route(lg[None, :], 1))(logits)
+        dispatch = dispatch[:, 0, :, 0].astype(dt)   # (T, E) 0/1 routing
+        combine = combine[:, 0, :, 0].astype(dt)     # (T, E) gate-weighted
+        xe = jnp.einsum("te,td->etd", dispatch, xt)
+        up = jax.nn.gelu(
+            jnp.einsum("etd,edf->etf", xe, lp["moe_up"].astype(dt)))
+        down = jnp.einsum("etf,efd->etd", up, lp["moe_down"].astype(dt))
+        out = jnp.einsum("te,etd->td", combine, down)
+        return out.reshape(*lead, d).astype(hx.dtype)
+
     def _prefill(self, params, ids, n, seed, max_new, temp):
         """Batched prompt prefill -> the full decode state pytree (leading
         dim B): per-layer KV for the prompt, plus the FIRST sampled token.
@@ -222,8 +333,7 @@ class TextGenServing(GenerativeModel):
             a = self._attend_prefill(q, k, v, key_bias).reshape(b, p, h * hd)
             x = x + a.astype(dt) @ lp["wo"].astype(dt)
             hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
-            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
-                     @ lp["w_down"].astype(dt))
+            x = x + self._mlp(lp, hx, dt)
         h_last = jnp.take_along_axis(
             x, jnp.maximum(n - 1, 0)[:, None, None], axis=1)[:, 0, :]
         first = self._sample(self._logits(params, h_last[:, None, :])[:, 0, :],
@@ -265,8 +375,7 @@ class TextGenServing(GenerativeModel):
             o = jnp.einsum("bhc,bchd->bhd", a, vc[:, i]).reshape(b, h * hd)
             x = x + o @ lp["wo"].astype(dt)
             hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
-            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
-                     @ lp["w_down"].astype(dt))
+            x = x + self._mlp(lp, hx, dt)
         logits = self._logits(params, x[:, None, :])[:, 0, :]
         sampled = self._sample(logits, state["seed"],
                                jnp.clip(pos + 1, 0, c - 1), state["temp"])
@@ -480,8 +589,7 @@ class TextGenServing(GenerativeModel):
             o = jnp.einsum("hqk,khd->qhd", a, vall).reshape(C, h * hd)
             x = x + o @ lp["wo"].astype(dt)
             hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
-            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
-                     @ lp["w_down"].astype(dt))
+            x = x + self._mlp(lp, hx, dt)
         last_off = jnp.clip(n - 1 - start, 0, C - 1)
         h_last = jax.lax.dynamic_index_in_dim(x, last_off, 0, keepdims=False)
         logits = self._logits(params, h_last[None, None, :])[0, 0]
@@ -542,8 +650,7 @@ class TextGenServing(GenerativeModel):
             o = jnp.einsum("bhc,bchd->bhd", a, vc).reshape(b, h * hd)
             x = x + o @ lp["wo"].astype(dt)
             hx = _norm(x, lp["ln2"]["scale"], lp["ln2"]["bias"])
-            x = x + (jax.nn.gelu(hx @ lp["w_up"].astype(dt))
-                     @ lp["w_down"].astype(dt))
+            x = x + self._mlp(lp, hx, dt)
         logits = self._logits(params, x[:, None, :])[:, 0, :]
         sampled = self._sample(logits, state["seed"],
                                jnp.clip(pos + 1, 0, c - 1), state["temp"])

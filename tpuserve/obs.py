@@ -564,7 +564,7 @@ SLO_ALERT_STATES = {"ok": 0.0, "pending": 1.0, "firing": 2.0}
 # clear-time Retry-After, same contract as queue-full).
 SCHED_SHED_REASONS = ("deadline_unmeetable", "priority_shed",
                       "share_exceeded", "model_warming", "burn_shed",
-                      "kv_pressure")
+                      "kv_pressure", "chip_budget")
 
 # Tenant admission rejections (tpuserve.scheduler.tenants), by cause.
 TENANT_SHED_REASONS = ("tenant_unknown", "tenant_rate_exceeded",
@@ -781,6 +781,47 @@ class Metrics:
         per batch."""
         return self.counter(
             f"device_seconds_total{{model={model},replica={replica}}}")
+
+    def gen_replica_steps_counter(self, model: str, replica: int) -> Counter:
+        """gen_replica_steps_total{model=,replica=}: decode iterations one
+        replica's generation engine executed (tpuserve.genserve.engine).
+        The generation twin of replica_batches_total: every replica
+        nonzero under sustained load is the proof least-loaded placement
+        keeps the whole mesh generating; a flat-zero replica is a starved
+        chip (docs/PERFORMANCE.md "Generation on the mesh"). Prebound at
+        engine construction — never call per step."""
+        return self.counter(
+            f"gen_replica_steps_total{{model={model},replica={replica}}}")
+
+    def gen_replica_units_counter(self, model: str, replica: int) -> Counter:
+        """gen_replica_units_total{model=,replica=}: output units (tokens,
+        images) retired by one replica's generation engine — the per-chip
+        decomposition of gen_units_total. Skew between replicas under a
+        mixed-length workload is expected (long generations pin a chip);
+        a replica whose units flatline while its steps climb is spinning
+        on never-finishing lanes. Prebound at engine construction."""
+        return self.counter(
+            f"gen_replica_units_total{{model={model},replica={replica}}}")
+
+    def gen_replica_active_gauge(self, model: str, replica: int) -> Gauge:
+        """gen_replica_active_slots{model=,replica=}: slots currently
+        generating on one replica's engine. The model-level
+        gen_active_slots{model=} gauge publishes the group SUM (metrics
+        are name-keyed singletons — N engines binding the model row share
+        one gauge); this row is the per-chip truth the placement balance
+        test reads. Sampled into /stats/history like every gauge."""
+        return self.gauge(
+            f"gen_replica_active_slots{{model={model},replica={replica}}}")
+
+    def gen_replica_kv_free_gauge(self, model: str, replica: int) -> Gauge:
+        """gen_replica_kv_pages_free{model=,replica=}: free KV pages in one
+        replica engine's page pool (paged mode only; ISSUE 18 ledger).
+        Each replica owns an independent pool, so the model-level
+        gen_kv_pages_free is the sum and THIS row is where pressure
+        actually binds — admission stalls on the replica whose pool runs
+        dry, not on the aggregate."""
+        return self.gauge(
+            f"gen_replica_kv_pages_free{{model={model},replica={replica}}}")
 
     def device_utilization_gauge(self, model: str, replica: int) -> Gauge:
         """device_utilization{model=,replica=}: fraction of wall time one

@@ -22,6 +22,8 @@ and ``tpuserve.ops.ulysses`` (head all-to-all).
 from tpuserve.parallel.distributed import init_distributed, process_info  # noqa: F401
 from tpuserve.parallel.mesh import (  # noqa: F401
     MeshPlan,
+    axis_size,
+    can_shard,
     host_major_grid,
     make_mesh,
     batch_sharding,
@@ -39,4 +41,5 @@ from tpuserve.parallel.partition import (  # noqa: F401
     match_partition_rules,
     named_leaves,
     shard_pytree,
+    struct_shardings,
 )

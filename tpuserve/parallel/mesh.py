@@ -108,6 +108,20 @@ def pad_batch_to_mesh(batch_size: int, mesh: Mesh) -> int:
     return ((batch_size + d - 1) // d) * d
 
 
+def axis_size(mesh: Mesh, axis: str) -> int:
+    """Size of a named mesh axis (1 when the axis is free)."""
+    return int(mesh.shape.get(axis, 1))
+
+
+def can_shard(mesh: Mesh, axis: str, dim: int) -> bool:
+    """True when ``dim`` divides evenly over a >1-sized mesh axis — the
+    gate generative state specs apply before pinning a heads/pages dim to
+    an axis, so a layout that doesn't divide falls back to replication
+    instead of an XLA error."""
+    n = axis_size(mesh, axis)
+    return n > 1 and dim % n == 0
+
+
 def select_devices(n_chips: int = 0, devices: list | None = None) -> list:
     """The device set a ``[parallel]`` plan serves on.
 

@@ -79,5 +79,20 @@ def shard_pytree(params: Any, rules: list[tuple[str, P]], mesh: Mesh) -> Any:
     return jax.tree_util.tree_map(jax.device_put, params, shardings)
 
 
+def struct_shardings(mesh: Mesh, struct: Any, specs: Any = None) -> Any:
+    """Shardings for a program-argument struct tree.
+
+    ``specs=None`` replicates every leaf (the default for generative
+    program arguments — slot indices, token blocks). A PartitionSpec tree
+    pins leaves to axes (the sharded-decode state block puts KV heads on
+    "model" and pages on "seq"); it may be a pytree prefix of ``struct``,
+    which jax.jit broadcasts over the matching subtree.
+    """
+    if specs is None:
+        repl = NamedSharding(mesh, P())
+        return jax.tree_util.tree_map(lambda _s: repl, struct)
+    return specs_to_shardings(specs, mesh)
+
+
 # A catch-all: replicate everything (correct default for DP inference).
 REPLICATED_RULES: list[tuple[str, P]] = [(".*", P())]

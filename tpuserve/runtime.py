@@ -46,10 +46,22 @@ from tpuserve.obs import Metrics
 from tpuserve.utils.retrace import allow_transfers, host_fetch
 from tpuserve.parallel import make_mesh, match_partition_rules
 from tpuserve.parallel.mesh import MeshPlan, plan_for, select_devices
-from tpuserve.parallel.partition import specs_to_shardings
+from tpuserve.parallel.partition import specs_to_shardings, struct_shardings
 from tpuserve.utils.locks import new_lock
 
 log = logging.getLogger("tpuserve.runtime")
+
+# Sharding-invariant RNG (ISSUE 20). The default ThreeFry lowering draws
+# DIFFERENT bits when GSPMD partitions a sample's output across devices: a
+# vocab-sharded logits + gumbel draw under tensor parallelism flips sampled
+# tokens vs the single-device lowering (observed: same state, same key, a
+# 1.12-gap argmax landing on a different token). The partitionable lowering
+# computes each element's bits independent of device layout — the property
+# the sharded decode's token-identical-to-single-mesh obligation rests on
+# (docs/PERFORMANCE.md "Generation on the mesh"). Process-global, set at
+# import, so every sampling path (engine, locked batch, bench) shares one
+# stream.
+jax.config.update("jax_threefry_partitionable", True)
 
 
 class NaNDetected(ValueError):
@@ -136,7 +148,7 @@ class GenProgram:
     recompile obligation covers slot churn and reloads in one counter."""
 
     tag: str
-    compiled: Any  # jax.stages.Compiled
+    compiled: list  # jax.stages.Compiled, one per replica mesh
     donated: bool = False
     counter: Any = None  # prebound runtime_variant_batches_total{variant=}
 
@@ -574,7 +586,9 @@ class ModelRuntime:
     # -- generative programs (tpuserve.genserve) ------------------------------
     def register_program(self, tag: str, fn, arg_structs: tuple,
                          width: int = 0,
-                         donate_argnums: tuple = ()) -> GenProgram:
+                         donate_argnums: tuple = (),
+                         arg_specs: "tuple | None" = None,
+                         out_specs: Any = None) -> GenProgram:
         """AOT-compile ``fn(params, *args)`` against the live param
         structure and register it in the specialized-variant registry.
 
@@ -595,43 +609,69 @@ class ModelRuntime:
         churn, and chunked-prefill progress all replay the same compiled
         executables (``runtime_compiles_total`` steady-state delta 0).
 
-        v1 composes with single-mesh layouts only ("single"/"sharded" —
-        the engine owns one device state block); ``arg_structs`` leaves are
-        replicated (P()) onto the mesh, params keep their partition-rule
-        shardings. ``donate_argnums`` indexes into ``args`` (0 = the first
-        arg after params) and is honored off-CPU only — on the CPU backend
-        device_put may alias host memory (the assembly-arena rule)."""
-        if len(self.meshes) != 1:
+        Layout composition (ISSUE 20): in "single"/"sharded" modes one
+        program is compiled against the one mesh; in "replica" mode the
+        SAME program is compiled once per replica mesh (mirroring
+        ``_compile_bucket``), so one ``GenEngine`` per replica dispatches
+        via ``run_program(..., replica=i)`` with no cross-engine contention
+        on compiled state. Pipeline mode does not compose — the engine
+        owns whole-model state, stage-stacked params don't.
+
+        ``arg_structs`` leaves are replicated (P()) onto the mesh unless
+        ``arg_specs`` (a tuple parallel to ``arg_structs`` of
+        PartitionSpec trees or ``None`` per arg) pins them to mesh axes —
+        the sharded decode path puts KV heads on "model" and pages on
+        "seq". ``out_specs`` (a PartitionSpec pytree-prefix of the output)
+        pins output shardings: REQUIRED whenever a sharded output feeds
+        back as an input of the same AOT executable (the engine's state
+        block), because ``jax.stages.Compiled`` demands exact input
+        shardings and would otherwise see GSPMD-chosen layouts drift.
+        Params keep their partition-rule shardings. ``donate_argnums``
+        indexes into ``args`` (0 = the first arg after params) and is
+        honored off-CPU only — on the CPU backend device_put may alias
+        host memory (the assembly-arena rule)."""
+        if self.mode == "pipeline":
             raise ValueError(
-                f"{self.model.name}: generative programs need a single-mesh "
-                f"layout (parallelism 'single' or 'sharded'); "
-                f"{self.mode!r} has {len(self.meshes)} meshes")
-        mesh = self.meshes[0]
-        params = self.params_per_mesh[0]
+                f"{self.model.name}: generative programs do not compose "
+                "with the pipeline layout (the engine owns whole-model "
+                "state; stage-stacked params do not)")
         t0 = time.perf_counter()
-        param_shardings = jax.tree_util.tree_map(lambda x: x.sharding, params)
-        params_struct = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                           sharding=x.sharding), params)
-        repl = NamedSharding(mesh, P())
-        arg_shardings = tuple(
-            jax.tree_util.tree_map(lambda _s: repl, struct)
-            for struct in arg_structs)
         donate = ()
         if donate_argnums and jax.default_backend() != "cpu":
             donate = tuple(1 + i for i in donate_argnums)
-        jitted = jax.jit(fn, in_shardings=(param_shardings, *arg_shardings),
-                         donate_argnums=donate)
-        compiled = jitted.lower(params_struct, *arg_structs).compile()
-        prog = GenProgram(tag, compiled, donated=bool(donate))
+        if arg_specs is None:
+            arg_specs = (None,) * len(arg_structs)
+        exes: list[Executable] = []
+        compiled_per_mesh: list = []
+        arg_shardings: tuple = ()
+        for i, mesh in enumerate(self.meshes):
+            params = self.params_per_mesh[i]
+            param_shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding, params)
+            params_struct = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=x.sharding), params)
+            arg_shardings = tuple(
+                struct_shardings(mesh, struct, spec)
+                for struct, spec in zip(arg_structs, arg_specs))
+            jit_kwargs: dict = {}
+            if out_specs is not None:
+                jit_kwargs["out_shardings"] = specs_to_shardings(
+                    out_specs, mesh)
+            jitted = jax.jit(fn,
+                             in_shardings=(param_shardings, *arg_shardings),
+                             donate_argnums=donate, **jit_kwargs)
+            compiled = jitted.lower(params_struct, *arg_structs).compile()
+            compiled_per_mesh.append(compiled)
+            exes.append(Executable((tag, width), compiled,
+                                   batch_sharding=arg_shardings,
+                                   device_index=i, donated=bool(donate)))
+        prog = GenProgram(tag, compiled_per_mesh, donated=bool(donate))
         self.gen_programs[tag] = prog
         key = self.variant_key((tag, width))
         self.variants[key] = Variant(
-            key, [Executable((tag, width), compiled,
-                             batch_sharding=arg_shardings,
-                             donated=bool(donate))],
-            compile_ms=(time.perf_counter() - t0) * 1e3)
-        self._c_compiles.inc()
+            key, exes, compile_ms=(time.perf_counter() - t0) * 1e3)
+        self._c_compiles.inc(len(exes))
         witness.note_compile(tag, key.label)  # retrace witness (see above)
         self._g_variants.set(len(self.variants))
         prog.counter = self._c_variant_batches[(tag, width)] = \
@@ -641,13 +681,18 @@ class ModelRuntime:
         return prog
 
     def run_program(self, tag: str, *args,
-                    params_override: "list[Any] | None" = None) -> Any:
+                    params_override: "list[Any] | None" = None,
+                    replica: int = 0) -> Any:
         """Async-dispatch a registered generative program against the LIVE
         param tree (or a staged candidate via ``params_override`` — the
         lifecycle's staged canary runs a short generation through the real
         compiled programs without the candidate ever serving). The params
         list is snapshotted per call, so every dispatch is version-
-        consistent and a mid-flight publish affects only later iterations."""
+        consistent and a mid-flight publish affects only later iterations.
+        ``replica`` selects the per-mesh executable + param copy in replica
+        mode (each replica engine passes its own index) and ticks that
+        replica's dispatch ledger so /stats' parallel block proves every
+        chip actually generates."""
         if self.injector is not None:
             delay = self.injector.delay_s("slow_compute", self.model.name)
             if delay > 0:
@@ -656,9 +701,10 @@ class ModelRuntime:
         prog = self.gen_programs[tag]
         if prog.counter is not None:
             prog.counter.inc()
+        self._c_replica_batches[replica].inc()
         params = (params_override if params_override is not None
                   else self.params_per_mesh)
-        return prog.compiled(params[0], *args)
+        return prog.compiled[replica](params[replica], *args)
 
     # -- hot path -----------------------------------------------------------
     @property

@@ -77,7 +77,7 @@ class _Entry:
     __slots__ = ("name", "batcher", "mcfg", "runtime", "warm_fn", "state",
                  "ledger", "window_sum", "last_used", "last_warm_s",
                  "next_warm_at", "warm_task", "shed_counters",
-                 "device_seconds_total")
+                 "device_seconds_total", "degree", "signature")
 
     def __init__(self, name: str, batcher: Any, mcfg: Any,
                  runtime: Any | None,
@@ -89,6 +89,13 @@ class _Entry:
         self.runtime = runtime
         self.warm_fn = warm_fn
         self.state = WARM
+        # Parallelism placement facts (ISSUE 20): how many chips this
+        # model occupies when warm, and the runtime's parallel signature
+        # ("replica@4", "sharded@d2", ...). Recycle pools and test doubles
+        # without a runtime count as one chip.
+        self.degree = max(1, int(getattr(runtime, "n_chips", 1) or 1))
+        self.signature = str(getattr(runtime, "parallel_signature",
+                                     "single") or "single")
         # Sliding-window device-seconds ledger: (monotonic ts, seconds).
         self.ledger: deque[tuple[float, float]] = deque()
         self.window_sum = 0.0
@@ -246,6 +253,13 @@ class FleetScheduler:
         model's first request triggers its warm-up as a side effect."""
         e = self._entries[model]
         if e.state != WARM:
+            if e.state == COLD and not self._fits_budget(e):
+                return self._shed(
+                    e, 503, "chip_budget",
+                    f"model {model!r} needs {e.degree} chip(s) but the "
+                    f"fleet chip budget ({self.cfg.chip_budget}) is "
+                    f"occupied ({self.chips_in_use()} in use)",
+                    clamp_retry_after_s(self.cfg.warm_retry_after_s) or 1)
             self._ensure_warming(e)
             eta = max(1, math.ceil(e.last_warm_s
                                    if e.last_warm_s
@@ -322,6 +336,40 @@ class FleetScheduler:
         the burn-rate signal admission policy can act on."""
         return self.slo.state_of(model) if self.slo is not None else "ok"
 
+    # -- chip-budget placement (ISSUE 20) -------------------------------------
+    def chips_in_use(self) -> int:
+        """Chips occupied by non-cold models — warm runtimes hold device
+        params on every chip of their degree, warming ones are staging
+        onto them."""
+        return sum(e.degree for e in self._entries.values()
+                   if e.state != COLD)
+
+    def _fits_budget(self, e: _Entry) -> bool:
+        """Whether warming ``e`` fits ``chip_budget``, demoting idle
+        cold_start models (largest degree first — frees the most chips
+        per staging cost) to make room. Placement is by parallelism
+        degree: a replica@4 textgen claims 4 chips, a single-chip
+        classifier 1, and the budget arbitrates between them."""
+        budget = self.cfg.chip_budget
+        if budget <= 0 or e.state != COLD:
+            return True
+
+        def overflow() -> int:
+            return self.chips_in_use() + e.degree - budget
+
+        if overflow() <= 0:
+            return True
+        victims = sorted(
+            (o for o in self._entries.values()
+             if o is not e and o.state == WARM and o.mcfg.cold_start
+             and o.batcher.pending == 0),
+            key=lambda o: -o.degree)
+        for o in victims:
+            if overflow() <= 0:
+                break
+            self.demote(o.name)
+        return overflow() <= 0
+
     # -- warm/cold state machine ----------------------------------------------
     def is_warm(self, model: str) -> bool:
         e = self._entries.get(model)
@@ -340,6 +388,8 @@ class FleetScheduler:
             return
         if time.monotonic() < e.next_warm_at:
             return
+        if not self._fits_budget(e):
+            return  # admission already shed 503 chip_budget
         e.warm_task = asyncio.get_running_loop().create_task(self._do_warm(e))
 
     async def _do_warm(self, e: _Entry) -> dict:
@@ -374,6 +424,11 @@ class FleetScheduler:
             return {"model": model, "state": WARM, "already_warm": True}
         if e.warm_fn is None:
             raise ValueError(f"model {model!r} has no warm path registered")
+        if e.state == COLD and not self._fits_budget(e):
+            raise ValueError(
+                f"model {model!r} needs {e.degree} chip(s) but the fleet "
+                f"chip budget ({self.cfg.chip_budget}) is occupied "
+                f"({self.chips_in_use()} in use)")
         e.next_warm_at = 0.0  # explicit ask overrides the failure backoff
         self._ensure_warming(e)
         return await asyncio.shield(e.warm_task)
@@ -443,6 +498,7 @@ class FleetScheduler:
                 "slo_alert": self.slo_state(name),
                 "priority": e.mcfg.priority,
                 "cold_start": e.mcfg.cold_start,
+                "parallel": {"signature": e.signature, "degree": e.degree},
                 "share": round(self.share(name), 4),
                 "device_seconds_window": round(e.window_sum, 4),
                 "device_seconds_total": round(e.device_seconds_total.value, 4),
@@ -460,5 +516,7 @@ class FleetScheduler:
             "overload_clear_s": self.cfg.overload_clear_s,
             "min_share": self.cfg.min_share,
             "idle_demote_s": self.cfg.idle_demote_s,
+            "chip_budget": self.cfg.chip_budget,
+            "chips_in_use": self.chips_in_use(),
             "models": models,
         }

@@ -66,7 +66,7 @@ from tpuserve.bench.roofline import compute_split, phase_p50
 from tpuserve.cache import ModelCache
 from tpuserve.config import ServerConfig, SloConfig
 from tpuserve.faults import CircuitBreaker, FaultInjector, Watchdog
-from tpuserve.genserve import GenEngine, KVPressure
+from tpuserve.genserve import GenEngine, GenEngineGroup, KVPressure
 from tpuserve.hostpipe import StageExecutors
 from tpuserve.lifecycle import ModelLifecycle, ReloadRejected
 from tpuserve.obs import (PRIORITIES, FlightRecorder, Metrics, TraceContext,
@@ -364,9 +364,20 @@ class ServerState:
                     rt = build_runtime(model, metrics=self.metrics,
                                        parallel=self.cfg.parallel,
                                        compile_forward=False)
-                    eng = GenEngine(model, rt, self.metrics,
-                                    self.cfg.genserve, stages=self.stages,
-                                    pipeline_cfg=self.cfg.pipeline)
+                    if getattr(rt, "n_replicas", 1) > 1:
+                        # Replica-per-chip engines (docs/PERFORMANCE.md
+                        # "Generation on the mesh"): one engine per replica
+                        # mesh, least-loaded placement, the engine surface
+                        # aggregated — everything downstream (watchdog,
+                        # lifecycle, scheduler, /stats) wires unchanged.
+                        eng = GenEngineGroup(model, rt, self.metrics,
+                                             self.cfg.genserve,
+                                             stages=self.stages,
+                                             pipeline_cfg=self.cfg.pipeline)
+                    else:
+                        eng = GenEngine(model, rt, self.metrics,
+                                        self.cfg.genserve, stages=self.stages,
+                                        pipeline_cfg=self.cfg.pipeline)
                     eng.compile()  # registers + prewarms the programs
                     self.engines[mcfg.name] = eng
                     # Armed after compile/prewarm, like the batcher path.
